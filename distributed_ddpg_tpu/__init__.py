@@ -1,0 +1,26 @@
+"""distributed_ddpg_tpu — a TPU-native distributed DDPG/D4PG framework.
+
+Re-designed from scratch for TPU (JAX/XLA/pjit/pallas) with the capability
+surface of camigord/Distributed_DDPG (see SURVEY.md; the reference mount was
+empty, so parity is against the behavioral spec in SURVEY.md §1-§6 and
+BASELINE.json):
+
+- Actor/critic MLPs with Polyak target networks (SURVEY.md §2 #3, #4).
+- TD-error critic loss + deterministic-policy-gradient actor loss
+  (SURVEY.md §3.3), fused into ONE jitted learner step.
+- CPU rollout workers with Ornstein-Uhlenbeck exploration and a host-side
+  replay buffer (uniform + prioritized) (SURVEY.md §2 #5, #6, #7).
+- The reference's async gRPC parameter-server gradient path (SURVEY.md §2 #10)
+  is replaced by XLA collectives over an ICI/DCN device mesh: a single
+  sharded learner step whose gradient AllReduce rides `jax.lax.psum` /
+  sharding-induced collectives instead of parameter-server round trips.
+- `--backend {native,jax_tpu}` gate: the pure-numpy `native` backend is the
+  bit-comparability oracle and CPU baseline (BASELINE.json:5).
+"""
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.agent import DDPGAgent
+
+__version__ = "0.1.0"
+
+__all__ = ["DDPGConfig", "DDPGAgent", "__version__"]

@@ -1,0 +1,120 @@
+"""Single frozen dataclass config, CLI-overridable (SURVEY.md §5 'Config').
+
+Replaces the reference's `tf.app.flags`/`settings.py` constants module
+(SURVEY.md §2 #8). Hyperparameter defaults follow the DDPG paper
+(arXiv 1509.02971) as recorded in SURVEY.md §2 #8: gamma=0.99, tau=1e-3,
+lr_actor=1e-4, lr_critic=1e-3, batch=64, buffer ~1e6, OU theta=0.15 sigma=0.2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class DDPGConfig:
+    """Hyperparameters and topology for one training run."""
+
+    # --- environment ---
+    env_id: str = "Pendulum-v1"
+    seed: int = 0
+
+    # --- networks (SURVEY.md §2 #3/#4: ~2 hidden layers, 400/300 or 256/256) ---
+    actor_hidden: Sequence[int] = (256, 256)
+    critic_hidden: Sequence[int] = (256, 256)
+    # Classic DDPG injects the action at the second critic layer (SURVEY.md §2 #4).
+    action_insert_layer: int = 1
+
+    # --- algorithm ---
+    gamma: float = 0.99
+    tau: float = 1e-3                # Polyak soft-update coefficient
+    actor_lr: float = 1e-4
+    critic_lr: float = 1e-3
+    critic_l2: float = 0.0           # weight decay on critic (paper uses 1e-2)
+    batch_size: int = 64
+    n_step: int = 1                  # n-step returns (D4PG, arXiv 1804.08617)
+
+    # --- distributional critic (D4PG) ---
+    distributional: bool = False
+    num_atoms: int = 51
+    v_min: float = -150.0
+    v_max: float = 150.0
+
+    # --- replay (SURVEY.md §2 #5/#7) ---
+    replay_capacity: int = 1_000_000
+    replay_min_size: int = 1_000     # warmup before learning starts
+    prioritized: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_beta_final: float = 1.0
+    per_eps: float = 1e-6
+
+    # --- exploration (SURVEY.md §2 #6) ---
+    ou_theta: float = 0.15
+    ou_sigma: float = 0.2
+    ou_dt: float = 1.0
+
+    # --- distributed topology ---
+    num_actors: int = 1
+    backend: str = "jax_tpu"         # {"native", "jax_tpu"} (BASELINE.json:5)
+    data_axis: int = -1              # -1: all devices on data axis
+    model_axis: int = 1              # tensor-parallel degree over hidden dims
+    train_every: int = 1             # env steps between learner steps (sync mode)
+    param_refresh_every: int = 1     # learner steps between actor param refresh
+    prefetch_depth: int = 2          # host->HBM double-buffer depth
+
+    # --- precision ---
+    compute_dtype: str = "float32"   # bit-comparability oracle needs f32
+    fused_update: bool = False       # pallas fused Adam+Polyak kernel
+
+    # --- run control ---
+    total_env_steps: int = 100_000
+    eval_every: int = 5_000
+    eval_episodes: int = 5
+    checkpoint_every: int = 10_000
+    checkpoint_dir: str = ""
+    log_path: str = ""               # JSONL metrics path ("" = stdout only)
+    profile_dir: str = ""            # jax.profiler trace dir ("" = off)
+    inject_fault: str = ""           # fault-injection hook (SURVEY.md §5)
+
+    def replace(self, **kwargs) -> "DDPGConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    @classmethod
+    def from_flags(cls, argv: Sequence[str]) -> "DDPGConfig":
+        """Parse `--key=value` / `--key value` CLI overrides onto the defaults."""
+        import argparse
+
+        parser = argparse.ArgumentParser(prog="distributed_ddpg_tpu")
+        for field in dataclasses.fields(cls):
+            if field.type in ("bool", bool):
+                parser.add_argument(
+                    f"--{field.name}",
+                    type=lambda s: s.lower() in ("1", "true", "yes"),
+                    default=field.default,
+                )
+            elif field.name in ("actor_hidden", "critic_hidden"):
+                parser.add_argument(
+                    f"--{field.name}",
+                    type=lambda s: tuple(int(x) for x in s.split(",")),
+                    default=field.default,
+                )
+            else:
+                ftype = {"int": int, "float": float, "str": str}.get(
+                    str(field.type), str
+                )
+                parser.add_argument(f"--{field.name}", type=ftype, default=field.default)
+        args = parser.parse_args(argv)
+        return cls(**vars(args))
+
+    def __post_init__(self):
+        if self.backend not in ("native", "jax_tpu"):
+            raise ValueError(f"backend must be 'native' or 'jax_tpu', got {self.backend!r}")
+        if self.n_step < 1:
+            raise ValueError("n_step must be >= 1")
+        if not 0 <= self.action_insert_layer <= len(self.critic_hidden):
+            raise ValueError(
+                f"action_insert_layer={self.action_insert_layer} out of range "
+                f"for critic with {len(self.critic_hidden) + 1} layers"
+            )

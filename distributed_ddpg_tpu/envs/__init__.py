@@ -1,0 +1,3 @@
+from distributed_ddpg_tpu.envs.registry import EnvSpec, make, spec_of
+
+__all__ = ["make", "spec_of", "EnvSpec"]

@@ -1,0 +1,114 @@
+"""Environment registry (SURVEY.md §1 'Environment' row).
+
+`make(env_id, seed)` resolves, in order:
+1. built-in pure-numpy envs (zero-dependency: Pendulum);
+2. gymnasium, if importable (covers the BASELINE.json ladder:
+   LunarLanderContinuous, BipedalWalker, HalfCheetah, Humanoid).
+
+Everything downstream (actors, replay, learner) only sees the EnvSpec +
+the gymnasium 5-tuple step API, so new env sources plug in here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from distributed_ddpg_tpu.envs.pendulum import Pendulum
+
+_BUILTIN = {
+    "Pendulum-v1": Pendulum,
+    "builtin/Pendulum-v1": Pendulum,
+}
+
+
+class EnvSpec(NamedTuple):
+    obs_dim: int
+    act_dim: int
+    action_low: np.ndarray
+    action_high: np.ndarray
+
+    @property
+    def action_scale(self) -> np.ndarray:
+        """Symmetric bound for tanh squashing (classic DDPG assumes
+        symmetric action spaces; asymmetric spaces use scale+offset)."""
+        return ((self.action_high - self.action_low) / 2.0).astype(np.float32)
+
+    @property
+    def action_offset(self) -> np.ndarray:
+        return ((self.action_high + self.action_low) / 2.0).astype(np.float32)
+
+
+class _GymnasiumAdapter:
+    """Wraps a gymnasium env; normalizes seeding and exposes spec fields."""
+
+    def __init__(self, env_id: str, seed: int = 0):
+        import gymnasium
+
+        self._env = gymnasium.make(env_id)
+        self._seed = seed
+        self._first_reset = True
+
+    def reset(self, seed: int | None = None):
+        if seed is None and self._first_reset:
+            seed = self._seed
+        self._first_reset = False
+        return self._env.reset(seed=seed)
+
+    def step(self, action):
+        return self._env.step(np.asarray(action, np.float32))
+
+    @property
+    def observation_dim(self) -> int:
+        return int(np.prod(self._env.observation_space.shape))
+
+    @property
+    def action_dim(self) -> int:
+        return int(np.prod(self._env.action_space.shape))
+
+    @property
+    def action_low(self) -> np.ndarray:
+        return np.asarray(self._env.action_space.low, np.float32)
+
+    @property
+    def action_high(self) -> np.ndarray:
+        return np.asarray(self._env.action_space.high, np.float32)
+
+    def close(self):
+        self._env.close()
+
+
+def make(env_id: str, seed: int = 0, prefer_builtin: bool = False):
+    if env_id in _BUILTIN and (prefer_builtin or not _has_gymnasium()):
+        return _BUILTIN[env_id](seed=seed)
+    if _has_gymnasium():
+        try:
+            return _GymnasiumAdapter(env_id, seed=seed)
+        except Exception:
+            if env_id in _BUILTIN:
+                return _BUILTIN[env_id](seed=seed)
+            raise
+    if env_id in _BUILTIN:
+        return _BUILTIN[env_id](seed=seed)
+    raise ValueError(
+        f"Unknown env {env_id!r}: not a builtin and gymnasium is unavailable"
+    )
+
+
+def _has_gymnasium() -> bool:
+    try:
+        import gymnasium  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def spec_of(env) -> EnvSpec:
+    return EnvSpec(
+        obs_dim=int(env.observation_dim),
+        act_dim=int(env.action_dim),
+        action_low=np.asarray(env.action_low, np.float32),
+        action_high=np.asarray(env.action_high, np.float32),
+    )

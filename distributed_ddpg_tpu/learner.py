@@ -1,0 +1,202 @@
+"""The fused learner step — the metric-defining hot loop (SURVEY.md §3.3).
+
+One pure function performs, in a single traced XLA program:
+  1. critic TD update (or D4PG categorical update),
+  2. DPG actor update (against the pre-update critic, matching the
+     reference's semantics where both gradients are computed from the same
+     forward values before either apply),
+  3. Adam for both nets,
+  4. Polyak target updates (SURVEY.md §3.4).
+
+The reference crosses the worker<->parameter-server gRPC boundary three times
+per step (params pull, grads push, target assign — SURVEY.md §3.3). Here the
+step compiles to one device program: zero host crossings; the only transfers
+are the incoming minibatch (double-buffered, learner_loop.py) and the
+outgoing per-sample TD errors for PER priority updates.
+
+`axis_name` threads an explicit `jax.lax.psum` gradient AllReduce for the
+shard_map/ICI path (parallel/learner.py); under plain jit+sharding the same
+collective is inserted by XLA from the sharding annotations, and psum is a
+no-op (axis_name=None).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.ops import losses
+from distributed_ddpg_tpu.ops.optim import adam_update
+from distributed_ddpg_tpu.ops.polyak import polyak_update
+from distributed_ddpg_tpu.types import Batch, OptState, TrainState
+from distributed_ddpg_tpu.models.mlp import actor_init, critic_init
+
+
+class StepOutput(NamedTuple):
+    state: TrainState
+    td_errors: jnp.ndarray   # f32[B] — for PER priority updates
+    metrics: dict
+
+
+def _maybe_psum_mean(tree, axis_name: Optional[str]):
+    if axis_name is None:
+        return tree
+    return jax.lax.pmean(tree, axis_name)
+
+
+def init_train_state(config: DDPGConfig, obs_dim: int, act_dim: int, seed: int) -> TrainState:
+    """Build initial params + hard-copied targets (SURVEY.md §3.4) + Adam state."""
+    key = jax.random.PRNGKey(seed)
+    k_actor, k_critic = jax.random.split(key)
+    num_outputs = config.num_atoms if config.distributional else 1
+    actor_params = actor_init(k_actor, obs_dim, act_dim, tuple(config.actor_hidden))
+    critic_params = critic_init(
+        k_critic,
+        obs_dim,
+        act_dim,
+        tuple(config.critic_hidden),
+        config.action_insert_layer,
+        num_outputs,
+    )
+    return TrainState(
+        actor_params=actor_params,
+        critic_params=critic_params,
+        target_actor_params=jax.tree.map(jnp.copy, actor_params),
+        target_critic_params=jax.tree.map(jnp.copy, critic_params),
+        actor_opt=OptState(
+            mu=jax.tree.map(jnp.zeros_like, actor_params),
+            nu=jax.tree.map(jnp.zeros_like, actor_params),
+            count=jnp.zeros((), jnp.int32),
+        ),
+        critic_opt=OptState(
+            mu=jax.tree.map(jnp.zeros_like, critic_params),
+            nu=jax.tree.map(jnp.zeros_like, critic_params),
+            count=jnp.zeros((), jnp.int32),
+        ),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_learner_step(
+    config: DDPGConfig,
+    action_scale,
+    axis_name: Optional[str] = None,
+    action_offset=0.0,
+):
+    """Returns the pure (state, batch) -> StepOutput function. Not jitted here:
+    callers wrap it in jit-with-shardings, shard_map, or call it under
+    interpretation for tests (parallel/learner.py owns device placement)."""
+    ail = config.action_insert_layer
+    scale = jnp.asarray(action_scale, jnp.float32)
+    offset = jnp.asarray(action_offset, jnp.float32)
+    support = (
+        losses.categorical_support(config.v_min, config.v_max, config.num_atoms)
+        if config.distributional
+        else None
+    )
+
+    def step(state: TrainState, batch: Batch) -> StepOutput:
+        # --- critic update ---
+        if config.distributional:
+            def critic_loss_fn(cp):
+                return losses.distributional_critic_loss(
+                    cp,
+                    state.target_actor_params,
+                    state.target_critic_params,
+                    batch,
+                    scale,
+                    support,
+                    ail,
+                    offset,
+                )
+        else:
+            def critic_loss_fn(cp):
+                return losses.critic_loss(
+                    cp,
+                    state.target_actor_params,
+                    state.target_critic_params,
+                    batch,
+                    scale,
+                    ail,
+                    config.critic_l2,
+                    offset,
+                )
+
+        (closs, td), cgrads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
+            state.critic_params
+        )
+        cgrads = _maybe_psum_mean(cgrads, axis_name)
+
+        # --- actor update (pre-update critic: both grads from the same state) ---
+        if config.distributional:
+            def actor_loss_fn(ap):
+                return losses.distributional_actor_loss(
+                    ap, state.critic_params, batch, scale, support, ail, offset
+                )
+        else:
+            def actor_loss_fn(ap):
+                return losses.actor_loss(ap, state.critic_params, batch, scale, ail, offset)
+
+        aloss, agrads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+        agrads = _maybe_psum_mean(agrads, axis_name)
+
+        new_critic, critic_opt = adam_update(
+            state.critic_params, cgrads, state.critic_opt, config.critic_lr
+        )
+        new_actor, actor_opt = adam_update(
+            state.actor_params, agrads, state.actor_opt, config.actor_lr
+        )
+
+        # --- Polyak target updates, fused in (SURVEY.md §3.4) ---
+        new_target_actor = polyak_update(new_actor, state.target_actor_params, config.tau)
+        new_target_critic = polyak_update(new_critic, state.target_critic_params, config.tau)
+
+        metrics = {
+            "critic_loss": closs,
+            "actor_loss": aloss,
+            "mean_q": -aloss,
+            "td_abs_mean": jnp.mean(jnp.abs(td)),
+            "critic_grad_norm": optree_norm(cgrads),
+            "actor_grad_norm": optree_norm(agrads),
+        }
+        new_state = TrainState(
+            actor_params=new_actor,
+            critic_params=new_critic,
+            target_actor_params=new_target_actor,
+            target_critic_params=new_target_critic,
+            actor_opt=actor_opt,
+            critic_opt=critic_opt,
+            step=state.step + 1,
+        )
+        return StepOutput(state=new_state, td_errors=td, metrics=metrics)
+
+    return step
+
+
+def optree_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
+
+
+def jit_learner_step(config: DDPGConfig, action_scale, donate: bool = True, action_offset=0.0):
+    """Single-device jitted step with donated TrainState (no HBM copy of the
+    params between steps)."""
+    step = make_learner_step(config, action_scale, action_offset=action_offset)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_act_fn(config: DDPGConfig, action_scale, action_offset=0.0):
+    """Jitted deterministic policy for evaluation/acting on device."""
+    from distributed_ddpg_tpu.models.mlp import actor_apply
+
+    scale = jnp.asarray(action_scale, jnp.float32)
+    offset = jnp.asarray(action_offset, jnp.float32)
+
+    @jax.jit
+    def act(actor_params, obs):
+        return actor_apply(actor_params, obs, scale, offset)
+
+    return act

@@ -1,0 +1,9 @@
+from distributed_ddpg_tpu.models.mlp import (
+    actor_apply,
+    actor_init,
+    critic_apply,
+    critic_init,
+    mlp_init,
+)
+
+__all__ = ["actor_init", "actor_apply", "critic_init", "critic_apply", "mlp_init"]

@@ -1,0 +1,112 @@
+"""Actor/critic MLPs as plain functional pytrees.
+
+Capability parity with the reference's `actor_network.py` / `critic_network.py`
+(SURVEY.md §2 #3/#4 — mount empty, spec from [PAPER]/[DRIVER] rows):
+
+- Actor mu(s; theta): MLP with relu hiddens, tanh-squashed final layer scaled
+  to the action bounds.
+- Critic Q(s, a; phi): MLP where the action enters at the SECOND layer
+  (classic DDPG, arXiv 1509.02971 §7).
+- Init: hidden layers ~ U(-1/sqrt(fan_in), +1/sqrt(fan_in)); final layers
+  ~ U(-3e-3, 3e-3) so initial policy outputs / Q values are near zero [PAPER].
+
+Design notes (TPU-first, not a port):
+- Params are plain pytrees (tuple of {"w","b"} dicts) — no framework objects —
+  so the same tree feeds the jitted TPU path, the numpy `native` backend
+  (bit-comparability oracle, BASELINE.json:5), and `jax.sharding` spec trees
+  that mirror the structure 1:1 (parallel/mesh.py).
+- All matmuls are batched [B, in] @ [in, out] so XLA tiles them onto the MXU;
+  no per-example Python loops anywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Tuple[Dict[str, Any], ...]
+
+FINAL_INIT_SCALE = 3e-3
+
+
+def _uniform(key, shape, bound, dtype):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def _linear_init(key, in_dim: int, out_dim: int, final: bool, dtype) -> Dict[str, Any]:
+    bound = FINAL_INIT_SCALE if final else 1.0 / math.sqrt(in_dim)
+    kw, kb = jax.random.split(key)
+    return {
+        "w": _uniform(kw, (in_dim, out_dim), bound, dtype),
+        "b": _uniform(kb, (out_dim,), bound, dtype),
+    }
+
+
+def mlp_init(key, dims: Sequence[int], dtype=jnp.float32) -> Params:
+    """Init a chain of linear layers with sizes dims[0] -> ... -> dims[-1]."""
+    n = len(dims) - 1
+    keys = jax.random.split(key, n)
+    return tuple(
+        _linear_init(keys[i], dims[i], dims[i + 1], final=(i == n - 1), dtype=dtype)
+        for i in range(n)
+    )
+
+
+def actor_init(key, obs_dim: int, act_dim: int, hidden: Sequence[int], dtype=jnp.float32) -> Params:
+    return mlp_init(key, [obs_dim, *hidden, act_dim], dtype)
+
+
+def actor_apply(params: Params, obs, action_scale, action_offset=0.0) -> Any:
+    """mu(s): relu hiddens, tanh output mapped onto the action box
+    [offset - scale, offset + scale] (offset != 0 for asymmetric spaces)."""
+    x = obs
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    x = x @ params[-1]["w"] + params[-1]["b"]
+    return jnp.tanh(x) * action_scale + action_offset
+
+
+def critic_init(
+    key,
+    obs_dim: int,
+    act_dim: int,
+    hidden: Sequence[int],
+    action_insert_layer: int = 1,
+    num_outputs: int = 1,
+    dtype=jnp.float32,
+) -> Params:
+    """Critic params. The layer at index `action_insert_layer` takes
+    [features, action] concatenated as its input (classic DDPG).
+    `num_outputs > 1` builds the categorical head for the D4PG
+    distributional critic (arXiv 1804.08617)."""
+    dims = [obs_dim, *hidden, num_outputs]
+    n = len(dims) - 1
+    if not 0 <= action_insert_layer < n:
+        raise ValueError(
+            f"action_insert_layer={action_insert_layer} out of range for a "
+            f"{n}-layer critic (valid: 0..{n - 1})"
+        )
+    keys = jax.random.split(key, n)
+    layers = []
+    for i in range(n):
+        in_dim = dims[i] + (act_dim if i == action_insert_layer else 0)
+        layers.append(_linear_init(keys[i], in_dim, dims[i + 1], final=(i == n - 1), dtype=dtype))
+    return tuple(layers)
+
+
+def critic_apply(params: Params, obs, action, action_insert_layer: int = 1) -> Any:
+    """Q(s, a) -> f32[B] (or f32[B, num_atoms] logits when distributional)."""
+    x = obs
+    n = len(params)
+    for i, layer in enumerate(params):
+        if i == action_insert_layer:
+            x = jnp.concatenate([x, action], axis=-1)
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    if x.shape[-1] == 1:
+        return jnp.squeeze(x, axis=-1)
+    return x

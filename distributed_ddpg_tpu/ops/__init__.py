@@ -1,0 +1,5 @@
+from distributed_ddpg_tpu.ops.optim import adam_update
+from distributed_ddpg_tpu.ops.polyak import polyak_update
+from distributed_ddpg_tpu.ops import losses
+
+__all__ = ["adam_update", "polyak_update", "losses"]

@@ -1,0 +1,150 @@
+"""DDPG / D4PG losses (SURVEY.md §3.3; DDPG arXiv 1509.02971, D4PG arXiv 1804.08617).
+
+- Critic: squared TD error against the bootstrapped target
+  y = r + discount * Q'(s', mu'(s')), where `discount` already folds
+  gamma^n * (1 - done) for n-step returns (types.Batch).
+- Actor: deterministic policy gradient, implemented as the scalar loss
+  -mean(Q(s, mu(s))) so `jax.grad` produces grad_theta mu(s) * grad_a Q.
+- Distributional critic (D4PG): categorical projection of the target
+  distribution onto a fixed support (C51-style), cross-entropy loss.
+
+All functions are pure and shape-static so they trace once under jit.
+PER importance weights enter as `batch.weight`; per-sample TD errors are
+returned for host-side priority updates (SURVEY.md §2 #7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_ddpg_tpu.models.mlp import actor_apply, critic_apply
+from distributed_ddpg_tpu.types import Batch
+
+
+def td_targets(batch: Batch, next_q):
+    return batch.reward + batch.discount * next_q
+
+
+def critic_loss(
+    critic_params,
+    target_actor_params,
+    target_critic_params,
+    batch: Batch,
+    action_scale,
+    action_insert_layer: int = 1,
+    l2: float = 0.0,
+    action_offset=0.0,
+):
+    """Weighted MSE TD loss. Returns (loss, td_errors[B])."""
+    next_action = actor_apply(target_actor_params, batch.next_obs, action_scale, action_offset)
+    next_q = critic_apply(
+        target_critic_params, batch.next_obs, next_action, action_insert_layer
+    )
+    y = jax.lax.stop_gradient(td_targets(batch, next_q))
+    q = critic_apply(critic_params, batch.obs, batch.action, action_insert_layer)
+    td = y - q
+    loss = jnp.mean(batch.weight * jnp.square(td))
+    if l2 > 0.0:
+        loss = loss + l2 * sum(
+            jnp.sum(jnp.square(layer["w"])) for layer in critic_params
+        )
+    return loss, td
+
+
+def actor_loss(
+    actor_params,
+    critic_params,
+    batch: Batch,
+    action_scale,
+    action_insert_layer: int = 1,
+    action_offset=0.0,
+):
+    """DPG loss: ascend Q(s, mu(s))."""
+    action = actor_apply(actor_params, batch.obs, action_scale, action_offset)
+    q = critic_apply(critic_params, batch.obs, action, action_insert_layer)
+    return -jnp.mean(q)
+
+
+# ---------------------------------------------------------------------------
+# Distributional critic (D4PG)
+# ---------------------------------------------------------------------------
+
+
+def categorical_support(v_min: float, v_max: float, num_atoms: int):
+    return jnp.linspace(v_min, v_max, num_atoms)
+
+
+def categorical_projection(support, target_probs, rewards, discounts):
+    """Project the shifted/scaled target distribution back onto `support`.
+
+    support: f32[A]; target_probs: f32[B, A]; rewards, discounts: f32[B].
+    Returns f32[B, A]. Standard C51 projection (vectorized, no Python loops —
+    traces to gathers/scatters XLA handles natively).
+    """
+    v_min, v_max = support[0], support[-1]
+    num_atoms = support.shape[0]
+    dz = (v_max - v_min) / (num_atoms - 1)
+    # Bellman-updated atom positions, clipped to the support: f32[B, A]
+    tz = jnp.clip(
+        rewards[:, None] + discounts[:, None] * support[None, :], v_min, v_max
+    )
+    b = (tz - v_min) / dz                 # fractional index in [0, A-1]
+    lower = jnp.floor(b)
+    upper = jnp.ceil(b)
+    # When b lands exactly on an atom, put all mass on it (lower == upper).
+    eq = (upper == lower).astype(target_probs.dtype)
+    w_lower = (upper - b) + eq            # mass to the lower atom
+    w_upper = b - lower
+    lo = lower.astype(jnp.int32)
+    up = upper.astype(jnp.int32)
+    onehot = jnp.eye(num_atoms, dtype=target_probs.dtype)
+    proj = jnp.einsum("ba,ba,baj->bj", target_probs, w_lower, onehot[lo])
+    proj = proj + jnp.einsum("ba,ba,baj->bj", target_probs, w_upper, onehot[up])
+    return proj
+
+
+def distributional_critic_loss(
+    critic_params,
+    target_actor_params,
+    target_critic_params,
+    batch: Batch,
+    action_scale,
+    support,
+    action_insert_layer: int = 1,
+    action_offset=0.0,
+):
+    """Categorical TD loss (cross-entropy vs projected target distribution).
+
+    Returns (loss, td_error_proxy[B]) where the proxy is |E[Z] - E[Z_target]|
+    (used for PER priorities, as in D4PG follow-ups)."""
+    next_action = actor_apply(target_actor_params, batch.next_obs, action_scale, action_offset)
+    target_logits = critic_apply(
+        target_critic_params, batch.next_obs, next_action, action_insert_layer
+    )
+    target_probs = jax.nn.softmax(target_logits, axis=-1)
+    proj = jax.lax.stop_gradient(
+        categorical_projection(support, target_probs, batch.reward, batch.discount)
+    )
+    logits = critic_apply(critic_params, batch.obs, batch.action, action_insert_layer)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.sum(proj * logprobs, axis=-1)
+    loss = jnp.mean(batch.weight * ce)
+    mean_q = jnp.sum(jax.nn.softmax(logits, axis=-1) * support[None, :], axis=-1)
+    mean_target = jnp.sum(proj * support[None, :], axis=-1)
+    return loss, mean_target - mean_q
+
+
+def distributional_actor_loss(
+    actor_params,
+    critic_params,
+    batch: Batch,
+    action_scale,
+    support,
+    action_insert_layer: int = 1,
+    action_offset=0.0,
+):
+    action = actor_apply(actor_params, batch.obs, action_scale, action_offset)
+    logits = critic_apply(critic_params, batch.obs, action, action_insert_layer)
+    q = jnp.sum(jax.nn.softmax(logits, axis=-1) * support[None, :], axis=-1)
+    return -jnp.mean(q)

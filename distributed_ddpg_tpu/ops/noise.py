@@ -1,0 +1,49 @@
+"""Ornstein-Uhlenbeck exploration noise (SURVEY.md §2 #6).
+
+Per-worker, CPU-side, reset per episode — identical role to the reference's
+`ou_noise.py` [RECALL]. Vectorized over an arbitrary leading shape so one
+process can drive a batched vector env. theta=0.15, sigma=0.2 defaults from
+the DDPG paper (SURVEY.md §2 #8).
+
+dx = theta * (mu - x) * dt + sigma * sqrt(dt) * N(0, 1)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class OUNoise:
+    def __init__(
+        self,
+        shape,
+        theta: float = 0.15,
+        sigma: float = 0.2,
+        mu: float = 0.0,
+        dt: float = 1.0,
+        seed: int = 0,
+    ):
+        self.shape = tuple(np.atleast_1d(shape))
+        self.theta = theta
+        self.sigma = sigma
+        self.mu = mu
+        self.dt = dt
+        self._rng = np.random.default_rng(seed)
+        self.state = np.full(self.shape, mu, dtype=np.float32)
+
+    def reset(self, mask=None):
+        """Reset to the mean. `mask` (bool, leading dims) resets only those
+        rows — used when individual envs in a vector env terminate."""
+        if mask is None:
+            self.state[...] = self.mu
+        else:
+            self.state[np.asarray(mask)] = self.mu
+
+    def __call__(self) -> np.ndarray:
+        noise = self._rng.standard_normal(self.shape).astype(np.float32)
+        self.state = (
+            self.state
+            + self.theta * (self.mu - self.state) * self.dt
+            + self.sigma * np.sqrt(self.dt) * noise
+        )
+        return self.state

@@ -1,0 +1,43 @@
+"""Explicit Adam, tree-level.
+
+Written out (rather than hidden behind an optimizer-library object) for three
+reasons tied to this framework's contract:
+1. the numpy `native` backend must produce bit-comparable updates
+   (BASELINE.json:5) — same formulas, same order of operations;
+2. the pallas fused Adam+Polyak kernel (ops/fused_update.py) needs the
+   scalar math exposed;
+3. the whole update lives inside the one jitted learner step — there is no
+   optimizer.apply_gradients host round trip like the reference's
+   parameter-server path (SURVEY.md §3.3).
+
+Formulation matches optax.adam defaults (b1=0.9, b2=0.999, eps=1e-8,
+eps_root=0): bias-corrected moments, eps added outside the sqrt.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_ddpg_tpu.types import OptState
+
+B1 = 0.9
+B2 = 0.999
+EPS = 1e-8
+
+
+def adam_update(params, grads, opt: OptState, lr):
+    """One Adam step. Returns (new_params, new_opt)."""
+    count = opt.count + 1
+    c = count.astype(jnp.float32)
+    bc1 = 1.0 - B1 ** c
+    bc2 = 1.0 - B2 ** c
+    mu = jax.tree.map(lambda m, g: B1 * m + (1.0 - B1) * g, opt.mu, grads)
+    nu = jax.tree.map(lambda v, g: B2 * v + (1.0 - B2) * (g * g), opt.nu, grads)
+    new_params = jax.tree.map(
+        lambda p, m, v: p - lr * (m / bc1) / (jnp.sqrt(v / bc2) + EPS),
+        params,
+        mu,
+        nu,
+    )
+    return new_params, OptState(mu=mu, nu=nu, count=count)

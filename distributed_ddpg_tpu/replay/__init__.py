@@ -1,0 +1,26 @@
+from distributed_ddpg_tpu.replay.uniform import UniformReplay
+from distributed_ddpg_tpu.replay.prioritized import PrioritizedReplay
+from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+
+
+def make_replay(config, obs_dim: int, act_dim: int):
+    """Replay factory honoring config.prioritized (SURVEY.md §2 #5/#7)."""
+    if config.prioritized:
+        return PrioritizedReplay(
+            capacity=config.replay_capacity,
+            obs_dim=obs_dim,
+            act_dim=act_dim,
+            alpha=config.per_alpha,
+            beta=config.per_beta,
+            eps=config.per_eps,
+            seed=config.seed,
+        )
+    return UniformReplay(
+        capacity=config.replay_capacity,
+        obs_dim=obs_dim,
+        act_dim=act_dim,
+        seed=config.seed,
+    )
+
+
+__all__ = ["UniformReplay", "PrioritizedReplay", "NStepAccumulator", "make_replay"]

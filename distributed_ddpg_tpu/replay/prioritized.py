@@ -1,0 +1,81 @@
+"""Prioritized experience replay (SURVEY.md §2 #7; BASELINE.json:9).
+
+Proportional PER (Schaul et al.) over the SoA ring storage of UniformReplay:
+priorities p_i = (|td_i| + eps)^alpha in a sum-tree, stratified sampling,
+importance weights w_i = (N * P(i))^-beta normalized by max w. beta anneals
+host-side via `set_beta` (config.per_beta -> per_beta_final).
+
+New transitions enter at the current max priority so every transition is
+seen at least once. The learner returns per-sample TD errors from the jitted
+step (learner.py StepOutput) and the host calls `update_priorities` — the
+only extra device->host transfer PER costs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from distributed_ddpg_tpu.replay.sum_tree import SumTree
+from distributed_ddpg_tpu.replay.uniform import UniformReplay
+
+
+class PrioritizedReplay(UniformReplay):
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int,
+        act_dim: int,
+        alpha: float = 0.6,
+        beta: float = 0.4,
+        eps: float = 1e-6,
+        seed: int = 0,
+    ):
+        super().__init__(capacity, obs_dim, act_dim, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._tree = SumTree(capacity)
+        self._max_priority = 1.0
+
+    def set_beta(self, beta: float) -> None:
+        self.beta = float(beta)
+
+    def add_batch(self, obs, action, reward, discount, next_obs) -> np.ndarray:
+        idx = super().add_batch(obs, action, reward, discount, next_obs)
+        self._tree.set(idx, np.full(len(idx), self._max_priority))
+        return idx
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._tree.stratified_sample(batch_size, self._rng)
+        # Ring slots beyond the current fill can only be sampled if their
+        # priority is zero-mass; clip defensively anyway.
+        idx = np.minimum(idx, self._size - 1)
+        out = self.gather(idx)
+        prios = self._tree.get(idx)
+        probs = prios / max(self._tree.total, 1e-12)
+        weights = (self._size * probs) ** (-self.beta)
+        weights /= weights.max()
+        out["weight"] = weights.astype(np.float32)
+        out["indices"] = idx
+        return out
+
+    def update_priorities(self, indices, td_errors) -> None:
+        prios = (np.abs(np.asarray(td_errors, np.float64)) + self.eps) ** self.alpha
+        self._tree.set(np.asarray(indices), prios)
+        self._max_priority = max(self._max_priority, float(prios.max(initial=0.0)))
+
+    # --- checkpoint support ---
+
+    def state_dict(self):
+        state = super().state_dict()
+        state["priorities"] = self._tree.get(np.arange(self._size)).copy()
+        state["max_priority"] = np.asarray(self._max_priority)
+        return state
+
+    def load_state_dict(self, state) -> None:
+        super().load_state_dict(state)
+        if "priorities" in state:
+            self._tree.set(np.arange(self._size), state["priorities"])
+            self._max_priority = float(state["max_priority"])

@@ -1,0 +1,103 @@
+"""Uniform replay: a structure-of-arrays numpy ring buffer (SURVEY.md §2 #5).
+
+Parity with the reference's `replay_buffer.py` (CPU deque/ring, `add`,
+`sample(N) -> stacked arrays` [DRIVER]) but TPU-feed-oriented:
+
+- Preallocated contiguous SoA arrays, not a deque of tuples: `sample` is one
+  fancy-index gather per field, already laid out for `jax.device_put` —
+  no per-sample Python in the hot path (SURVEY.md §7 'hard parts (a)').
+- Stores `discount = gamma^n * (1 - done)` folded by the n-step accumulator,
+  so the learner's TD target is a single fused multiply-add.
+- `state_dict()`/`load_state_dict()` make the buffer checkpointable
+  (SURVEY.md §3.5 says the reference never checkpoints replay; we do).
+- When the C++ native core is available (native/ directory) the sampling
+  index generation and gathers can be delegated to it; the numpy path is the
+  always-available fallback with identical semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class UniformReplay:
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int, seed: int = 0):
+        self.capacity = int(capacity)
+        self._rng = np.random.default_rng(seed)
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.action = np.zeros((capacity, act_dim), np.float32)
+        self.reward = np.zeros((capacity,), np.float32)
+        self.discount = np.zeros((capacity,), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self._ptr = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, obs, action, reward, discount, next_obs) -> np.ndarray:
+        """Insert B transitions; returns the slots written (for PER subclass)."""
+        obs = np.atleast_2d(obs)
+        b = obs.shape[0]
+        idx = (self._ptr + np.arange(b)) % self.capacity
+        self.obs[idx] = obs
+        self.action[idx] = np.atleast_2d(action)
+        self.reward[idx] = np.asarray(reward, np.float32).reshape(b)
+        self.discount[idx] = np.asarray(discount, np.float32).reshape(b)
+        self.next_obs[idx] = np.atleast_2d(next_obs)
+        self._ptr = int((self._ptr + b) % self.capacity)
+        self._size = int(min(self._size + b, self.capacity))
+        return idx
+
+    def add(self, obs, action, reward, discount, next_obs) -> int:
+        return int(self.add_batch(obs[None], action[None], [reward], [discount], next_obs[None])[0])
+
+    def sample_indices(self, batch_size: int) -> np.ndarray:
+        return self._rng.integers(0, self._size, size=batch_size)
+
+    def gather(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        return {
+            "obs": self.obs[idx],
+            "action": self.action[idx],
+            "reward": self.reward[idx],
+            "discount": self.discount[idx],
+            "next_obs": self.next_obs[idx],
+            "weight": np.ones(len(idx), np.float32),
+        }
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self.sample_indices(batch_size)
+        out = self.gather(idx)
+        out["indices"] = idx
+        return out
+
+    def update_priorities(self, indices, td_errors) -> None:
+        """No-op for uniform replay (interface shared with PER)."""
+
+    # --- checkpoint support (SURVEY.md §5 'Checkpoint / resume') ---
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        n = self._size
+        return {
+            "obs": self.obs[:n].copy(),
+            "action": self.action[:n].copy(),
+            "reward": self.reward[:n].copy(),
+            "discount": self.discount[:n].copy(),
+            "next_obs": self.next_obs[:n].copy(),
+            "ptr": np.asarray(self._ptr),
+            "size": np.asarray(self._size),
+        }
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        n = int(state["size"])
+        if n > self.capacity:
+            raise ValueError(f"checkpointed size {n} exceeds capacity {self.capacity}")
+        self.obs[:n] = state["obs"]
+        self.action[:n] = state["action"]
+        self.reward[:n] = state["reward"]
+        self.discount[:n] = state["discount"]
+        self.next_obs[:n] = state["next_obs"]
+        self._ptr = int(state["ptr"]) % self.capacity
+        self._size = n

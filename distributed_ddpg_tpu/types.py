@@ -1,0 +1,57 @@
+"""Core pytree types: transitions, batches, and the learner TrainState.
+
+The reference keeps its state scattered across TF graph variables on the
+parameter server (SURVEY.md §1 'Distribution/comm'); here everything the
+learner owns is ONE explicit pytree so the whole train step — losses, Adam,
+Polyak — jits into a single XLA program with no host round trips
+(SURVEY.md §3.3/§3.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import numpy as np
+
+
+class Batch(NamedTuple):
+    """A replay minibatch. `discount` already folds gamma^n * (1 - done) for
+    n-step returns (D4PG), so the TD target is `r + discount * Q'(s', mu'(s'))`."""
+
+    obs: Any          # f32[B, obs_dim]
+    action: Any       # f32[B, act_dim]
+    reward: Any       # f32[B]     (n-step discounted sum)
+    discount: Any     # f32[B]     (gamma^n * (1 - done))
+    next_obs: Any     # f32[B, obs_dim]
+    weight: Any       # f32[B]     (PER importance weights; ones if uniform)
+
+
+class OptState(NamedTuple):
+    """Adam state for one parameter tree (matches optax.adam semantics)."""
+
+    mu: Any           # first moment
+    nu: Any           # second moment
+    count: Any        # i32 step counter
+
+
+class TrainState(NamedTuple):
+    """Everything owned by the learner, as one donated pytree."""
+
+    actor_params: Any
+    critic_params: Any
+    target_actor_params: Any
+    target_critic_params: Any
+    actor_opt: OptState
+    critic_opt: OptState
+    step: Any         # i32
+
+
+def batch_from_numpy(arrays: Dict[str, np.ndarray]) -> Batch:
+    return Batch(
+        obs=arrays["obs"],
+        action=arrays["action"],
+        reward=arrays["reward"],
+        discount=arrays["discount"],
+        next_obs=arrays["next_obs"],
+        weight=arrays.get("weight", np.ones_like(arrays["reward"])),
+    )

@@ -1,0 +1,22 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform BEFORE any
+backend is initialized (SURVEY.md §4 'Distributed without a cluster'). This
+exercises the mesh/sharding/collective paths with no TPU attached; the driver
+separately dry-runs the multichip path via __graft_entry__.dryrun_multichip.
+
+Note: this image's site customization registers a remote 'axon' TPU platform
+and programmatically sets jax_platforms='axon,cpu', which overrides the
+JAX_PLATFORMS env var — so we must win the override via jax.config.update
+AFTER importing jax, in addition to setting XLA_FLAGS for the fake devices.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

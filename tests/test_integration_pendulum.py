@@ -1,0 +1,54 @@
+"""Integration ladder rung 1 (SURVEY.md §4, BASELINE.json:7): Pendulum-v1,
+1 worker, small nets — must solve within the step budget, deterministic
+given the seed. Uses the built-in zero-dependency Pendulum env."""
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.agent import DDPGAgent
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.envs import make, spec_of
+
+
+def _run(total_steps: int, seed: int = 0) -> float:
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1",
+        actor_hidden=(64, 64),
+        critic_hidden=(64, 64),
+        replay_capacity=100_000,
+        replay_min_size=1_000,
+        batch_size=64,
+        actor_lr=3e-4,
+        critic_lr=1e-3,
+        tau=5e-3,
+        seed=seed,
+    )
+    env = make(cfg.env_id, seed=seed, prefer_builtin=True)
+    agent = DDPGAgent(cfg, spec_of(env))
+    obs, _ = env.reset(seed=seed)
+    agent.reset_episode()
+    for _ in range(total_steps):
+        a = agent.act(obs)
+        nobs, r, term, trunc, _ = env.step(a)
+        agent.observe(obs, a, r, term, nobs)
+        obs = nobs
+        if term or trunc:
+            obs, _ = env.reset()
+            agent.reset_episode()
+        agent.train_step()
+    return agent.evaluate(
+        make(cfg.env_id, seed=9_999, prefer_builtin=True), episodes=5
+    )
+
+
+@pytest.mark.slow
+def test_pendulum_solves():
+    ret = _run(30_000)
+    assert ret > -250.0, f"Pendulum not solved: eval return {ret}"
+
+
+def test_pendulum_short_run_improves():
+    """Cheap CI proxy: 10k steps must clearly beat a random policy
+    (random evals around -1200..-1500; trained-10k runs land near -780)."""
+    ret = _run(10_000)
+    assert ret > -1050.0, f"no learning signal: eval return {ret}"

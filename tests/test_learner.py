@@ -1,0 +1,116 @@
+"""Learner-step tests: determinism under seed, target-network Polyak
+semantics inside the fused step, PER weight plumbing, distributional path
+shape/grad sanity (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.learner import (
+    init_train_state,
+    jit_learner_step,
+    make_learner_step,
+)
+from distributed_ddpg_tpu.types import Batch
+
+OBS, ACT, B = 5, 2, 16
+
+
+def _batch(key, b=B):
+    ks = jax.random.split(key, 3)
+    return Batch(
+        obs=jax.random.normal(ks[0], (b, OBS)),
+        action=jax.random.uniform(ks[1], (b, ACT), minval=-1, maxval=1),
+        reward=jax.random.normal(ks[2], (b,)),
+        discount=jnp.full((b,), 0.99),
+        next_obs=jax.random.normal(ks[0], (b, OBS)),
+        weight=jnp.ones((b,)),
+    )
+
+
+def _cfg(**kw):
+    base = dict(actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B)
+    base.update(kw)
+    return DDPGConfig(**base)
+
+
+def test_step_deterministic_under_seed():
+    cfg = _cfg()
+    batch = _batch(jax.random.PRNGKey(7))
+    outs = []
+    for _ in range(2):
+        state = init_train_state(cfg, OBS, ACT, seed=3)
+        step = jit_learner_step(cfg, 1.0, donate=False)
+        out = step(state, batch)
+        out = step(out.state, batch)
+        outs.append(out)
+    for a, b in zip(jax.tree.leaves(outs[0].state), jax.tree.leaves(outs[1].state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(outs[0].state.step) == 2
+
+
+def test_polyak_semantics_in_step():
+    """After one step: target == tau*new_online + (1-tau)*old_target, with
+    old_target == init online params (hard copy at init, SURVEY.md §3.4)."""
+    cfg = _cfg(tau=0.25)
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+    step = jit_learner_step(cfg, 1.0, donate=False)
+    out = step(state, _batch(jax.random.PRNGKey(0)))
+    expect = jax.tree.map(
+        lambda new, old: 0.25 * new + 0.75 * old,
+        out.state.actor_params,
+        state.actor_params,  # == initial target
+    )
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(out.state.target_actor_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_per_weights_scale_critic_grads():
+    """Zero IS weights must zero the critic TD gradient (only L2 remains)."""
+    cfg = _cfg()
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+    step = make_learner_step(cfg, 1.0)
+    batch = _batch(jax.random.PRNGKey(1))
+    zero_w = batch._replace(weight=jnp.zeros((B,)))
+    out = step(state, zero_w)
+    np.testing.assert_allclose(float(out.metrics["critic_loss"]), 0.0, atol=1e-7)
+    # Critic params unchanged direction-wise: grads were exactly zero → Adam
+    # update is 0/(0+eps) = 0.
+    for a, b in zip(jax.tree.leaves(state.critic_params), jax.tree.leaves(out.state.critic_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_td_errors_shape_and_finite():
+    cfg = _cfg()
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+    out = jit_learner_step(cfg, 1.0, donate=False)(state, _batch(jax.random.PRNGKey(2)))
+    td = np.asarray(out.td_errors)
+    assert td.shape == (B,) and np.isfinite(td).all()
+
+
+def test_distributional_step_runs_and_learns_shapes():
+    cfg = _cfg(distributional=True, num_atoms=21, v_min=-10.0, v_max=10.0)
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+    # Critic final layer must have num_atoms outputs.
+    assert state.critic_params[-1]["w"].shape[-1] == 21
+    step = jit_learner_step(cfg, 1.0, donate=False)
+    out = step(state, _batch(jax.random.PRNGKey(3)))
+    assert np.isfinite(float(out.metrics["critic_loss"]))
+    assert out.td_errors.shape == (B,)
+    # Params actually moved.
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(state.critic_params), jax.tree.leaves(out.state.critic_params))
+    )
+    assert moved
+
+
+def test_critic_l2_regularization_applied():
+    cfg0 = _cfg(critic_l2=0.0)
+    cfg1 = _cfg(critic_l2=0.1)
+    state = init_train_state(cfg0, OBS, ACT, seed=0)
+    batch = _batch(jax.random.PRNGKey(4))
+    l0 = make_learner_step(cfg0, 1.0)(state, batch).metrics["critic_loss"]
+    l1 = make_learner_step(cfg1, 1.0)(state, batch).metrics["critic_loss"]
+    assert float(l1) > float(l0)

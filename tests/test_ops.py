@@ -1,0 +1,161 @@
+"""Unit tests for the pure ops (SURVEY.md §4 'Unit' row): Polyak = exact
+lerp, Adam vs optax oracle, losses vs hand-computed closed forms, OU noise
+mean-reversion statistics, action squashing at bounds."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.models.mlp import (
+    actor_apply,
+    actor_init,
+    critic_apply,
+    critic_init,
+)
+from distributed_ddpg_tpu.ops import losses
+from distributed_ddpg_tpu.ops.noise import OUNoise
+from distributed_ddpg_tpu.ops.optim import adam_update
+from distributed_ddpg_tpu.ops.polyak import polyak_update
+from distributed_ddpg_tpu.types import Batch, OptState
+
+
+def test_polyak_is_exact_lerp():
+    online = {"w": jnp.ones((3,)) * 2.0}
+    target = {"w": jnp.zeros((3,))}
+    out = polyak_update(online, target, tau=0.25)
+    np.testing.assert_allclose(out["w"], 0.5 * jnp.ones(3))
+
+
+def test_adam_matches_optax():
+    params = {"w": jnp.array([1.0, -2.0, 3.0]), "b": jnp.array([0.5])}
+    opt = OptState(
+        mu=jax.tree.map(jnp.zeros_like, params),
+        nu=jax.tree.map(jnp.zeros_like, params),
+        count=jnp.zeros((), jnp.int32),
+    )
+    ox = optax.adam(1e-3)
+    ox_state = ox.init(params)
+    p_mine, p_ox = params, params
+    for i in range(5):
+        grads = jax.tree.map(lambda x: jnp.sin(x + i), p_ox)
+        p_mine, opt = adam_update(p_mine, jax.tree.map(lambda x: jnp.sin(x + i), p_mine), opt, 1e-3)
+        updates, ox_state = ox.update(grads, ox_state, p_ox)
+        p_ox = optax.apply_updates(p_ox, updates)
+    for k in params:
+        np.testing.assert_allclose(p_mine[k], p_ox[k], rtol=1e-6, atol=1e-7)
+
+
+def test_critic_loss_closed_form():
+    """On a linear critic with known weights the TD loss has a closed form."""
+    # 1-layer critic (action inserted at layer 0): Q = [s, a] @ w + b
+    params = ({"w": jnp.array([[1.0], [2.0]]), "b": jnp.array([0.5])},)
+    tparams = params
+    # target actor: single layer mapping s -> a, tanh-squashed
+    aparams = ({"w": jnp.array([[0.0]]), "b": jnp.array([0.0])},)
+    batch = Batch(
+        obs=jnp.array([[1.0]]),
+        action=jnp.array([[2.0]]),
+        reward=jnp.array([1.0]),
+        discount=jnp.array([0.9]),
+        next_obs=jnp.array([[0.0]]),
+        weight=jnp.array([1.0]),
+    )
+    # mu'(s') = tanh(0) = 0; Q'(s'=0, a=0) = 0.5 → y = 1 + 0.9*0.5 = 1.45
+    # Q(s,a) = 1*1 + 2*2 + 0.5 = 5.5 → td = -4.05, loss = 16.4025
+    loss, td = losses.critic_loss(
+        params, aparams, tparams, batch, action_scale=1.0, action_insert_layer=0
+    )
+    np.testing.assert_allclose(float(loss), 4.05**2, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(td), [-4.05], rtol=1e-6)
+
+
+def test_actor_loss_is_negative_mean_q():
+    key = jax.random.PRNGKey(0)
+    ap = actor_init(key, 3, 2, (16,))
+    cp = critic_init(key, 3, 2, (16,), action_insert_layer=1)
+    obs = jax.random.normal(key, (8, 3))
+    batch = Batch(obs=obs, action=None, reward=None, discount=None, next_obs=None, weight=None)
+    loss = losses.actor_loss(ap, cp, batch, action_scale=1.0)
+    a = actor_apply(ap, obs, 1.0)
+    q = critic_apply(cp, obs, a, 1)
+    np.testing.assert_allclose(float(loss), -float(jnp.mean(q)), rtol=1e-6)
+
+
+def test_action_squashing_at_bounds():
+    """Saturated pre-activations must squash exactly to ±action_scale."""
+    params = (
+        {"w": jnp.full((1, 1), 100.0), "b": jnp.zeros((1,))},
+    )
+    out_hi = actor_apply(params, jnp.array([[1.0]]), action_scale=2.0)
+    out_lo = actor_apply(params, jnp.array([[-1.0]]), action_scale=2.0)
+    np.testing.assert_allclose(out_hi, [[2.0]], atol=1e-5)
+    np.testing.assert_allclose(out_lo, [[-2.0]], atol=1e-5)
+
+
+def test_ou_noise_mean_reversion():
+    """Long-run OU statistics: mean ~ mu, std ~ sigma*sqrt(dt/(2*theta*dt - theta^2*dt^2))
+    ~ sigma/sqrt(2*theta) for small dt. Check mean reversion + bounded std."""
+    ou = OUNoise((1,), theta=0.15, sigma=0.2, dt=1.0, seed=0)
+    samples = np.array([ou() for _ in range(20000)])
+    # Discrete-time OU: x_{t+1} = (1-theta)x_t + sigma*N → var = sigma²/(1-(1-theta)²)
+    expected_std = 0.2 / np.sqrt(1 - (1 - 0.15) ** 2)
+    assert abs(samples[5000:].mean()) < 0.05
+    np.testing.assert_allclose(samples[5000:].std(), expected_std, rtol=0.1)
+    ou.reset()
+    np.testing.assert_allclose(ou.state, 0.0)
+
+
+def test_categorical_projection_identity():
+    """With reward=0, discount=1 the projection is the identity."""
+    support = losses.categorical_support(-1.0, 1.0, 5)
+    probs = jnp.array([[0.1, 0.2, 0.4, 0.2, 0.1]])
+    out = losses.categorical_projection(
+        support, probs, jnp.array([0.0]), jnp.array([1.0])
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(probs), atol=1e-6)
+
+
+def test_categorical_projection_terminal_delta():
+    """Terminal transition (discount=0) projects all mass onto reward atom."""
+    support = losses.categorical_support(-1.0, 1.0, 5)  # atoms at -1,-.5,0,.5,1
+    probs = jnp.full((1, 5), 0.2)
+    out = losses.categorical_projection(
+        support, probs, jnp.array([0.5]), jnp.array([0.0])
+    )
+    np.testing.assert_allclose(np.asarray(out)[0], [0, 0, 0, 1.0, 0], atol=1e-6)
+    # Off-atom reward splits mass linearly between neighbors.
+    out = losses.categorical_projection(
+        support, probs, jnp.array([0.25]), jnp.array([0.0])
+    )
+    np.testing.assert_allclose(np.asarray(out)[0], [0, 0, 0.5, 0.5, 0], atol=1e-6)
+
+
+def test_projection_mass_conserved():
+    key = jax.random.PRNGKey(1)
+    support = losses.categorical_support(-10.0, 10.0, 51)
+    logits = jax.random.normal(key, (32, 51))
+    probs = jax.nn.softmax(logits, -1)
+    r = jax.random.uniform(key, (32,), minval=-5, maxval=5)
+    d = jax.random.uniform(key, (32,), minval=0, maxval=1)
+    out = losses.categorical_projection(support, probs, r, d)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_actor_offset_for_asymmetric_spaces():
+    """tanh output must map onto [low, high] when the box is asymmetric."""
+    params = ({"w": jnp.full((1, 1), 100.0), "b": jnp.zeros((1,))},)
+    # Box [0, 1]: scale 0.5, offset 0.5.
+    hi = actor_apply(params, jnp.array([[1.0]]), action_scale=0.5, action_offset=0.5)
+    lo = actor_apply(params, jnp.array([[-1.0]]), action_scale=0.5, action_offset=0.5)
+    np.testing.assert_allclose(hi, [[1.0]], atol=1e-5)
+    np.testing.assert_allclose(lo, [[0.0]], atol=1e-5)
+
+
+def test_action_insert_layer_validation():
+    with pytest.raises(ValueError):
+        critic_init(jax.random.PRNGKey(0), 3, 2, (16, 16), action_insert_layer=3)
+    with pytest.raises(ValueError):
+        DDPGConfig(critic_hidden=(16, 16), action_insert_layer=5)

@@ -1,0 +1,124 @@
+"""Replay tests (SURVEY.md §4): ring wraparound, sampling distribution,
+sum-tree invariants, PER weights, n-step return math, checkpoint round-trip."""
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+from distributed_ddpg_tpu.replay.prioritized import PrioritizedReplay
+from distributed_ddpg_tpu.replay.sum_tree import SumTree
+from distributed_ddpg_tpu.replay.uniform import UniformReplay
+
+
+def _fill(buf, n, obs_dim=3, act_dim=2, start=0):
+    for i in range(start, start + n):
+        buf.add(
+            np.full(obs_dim, i, np.float32),
+            np.full(act_dim, i, np.float32),
+            float(i),
+            0.99,
+            np.full(obs_dim, i + 1, np.float32),
+        )
+
+
+def test_ring_wraparound():
+    buf = UniformReplay(capacity=8, obs_dim=3, act_dim=2)
+    _fill(buf, 11)
+    assert len(buf) == 8
+    # Slots 0..2 were overwritten by items 8,9,10.
+    assert buf.reward[0] == 8.0 and buf.reward[2] == 10.0 and buf.reward[3] == 3.0
+
+
+def test_uniform_sampling_distribution():
+    buf = UniformReplay(capacity=64, obs_dim=1, act_dim=1, seed=0)
+    _fill(buf, 64, obs_dim=1, act_dim=1)
+    counts = np.zeros(64)
+    for _ in range(200):
+        s = buf.sample(64)
+        np.testing.assert_array_equal(s["obs"][:, 0], s["reward"])  # SoA alignment
+        counts[s["indices"]] += 1
+    # Each slot expected 200 hits; loose 5-sigma band.
+    assert counts.min() > 100 and counts.max() < 320
+
+
+def test_sum_tree_invariants():
+    t = SumTree(capacity=10)  # rounds to 16
+    rng = np.random.default_rng(0)
+    prios = rng.uniform(0.1, 2.0, size=10)
+    t.set(np.arange(10), prios)
+    np.testing.assert_allclose(t.total, prios.sum(), rtol=1e-12)
+    # Every internal node equals the sum of its children.
+    tree = t.tree
+    for node in range(1, t.capacity):
+        np.testing.assert_allclose(tree[node], tree[2 * node] + tree[2 * node + 1])
+    # Descent hits the right leaf for exact prefix sums.
+    cum = np.cumsum(prios)
+    idx = t.sample(cum - 1e-9)
+    np.testing.assert_array_equal(idx, np.arange(10))
+
+
+def test_sum_tree_sampling_proportional():
+    t = SumTree(capacity=4)
+    t.set(np.arange(4), np.array([1.0, 0.0, 3.0, 0.0]))
+    rng = np.random.default_rng(1)
+    idx = t.stratified_sample(4000, rng)
+    counts = np.bincount(idx, minlength=4)
+    assert counts[1] == 0 and counts[3] == 0
+    np.testing.assert_allclose(counts[2] / counts[0], 3.0, rtol=0.15)
+
+
+def test_per_weights_and_priority_update():
+    buf = PrioritizedReplay(capacity=32, obs_dim=1, act_dim=1, alpha=1.0, beta=1.0, seed=0)
+    _fill(buf, 32, obs_dim=1, act_dim=1)
+    s = buf.sample(16)
+    # Fresh buffer: all priorities equal → all IS weights 1.
+    np.testing.assert_allclose(s["weight"], 1.0)
+    # Give slot 5 a huge TD error; it should dominate sampling.
+    buf.update_priorities(np.array([5]), np.array([100.0]))
+    hits = sum((buf.sample(32)["indices"] == 5).sum() for _ in range(50))
+    assert hits > 1000  # ~76% of 1600 draws expected
+    # And its IS weight must be the minimum (most down-weighted).
+    s = buf.sample(256)
+    w_of_5 = s["weight"][s["indices"] == 5]
+    assert len(w_of_5) and np.all(w_of_5 <= s["weight"].max())
+    assert np.all(s["weight"] <= 1.0 + 1e-9)
+
+
+def test_nstep_returns():
+    acc = NStepAccumulator(n=3, gamma=0.5, num_envs=1)
+    out = []
+    rewards = [1.0, 2.0, 3.0, 4.0]
+    for t, r in enumerate(rewards):
+        obs = np.array([[float(t)]])
+        nxt = np.array([[float(t + 1)]])
+        done = [t == 3]
+        out.extend(acc.push(obs, obs, [r], done, nxt))
+    # Window [0,1,2]: R = 1 + .5*2 + .25*3 = 2.75, discount .125, bootstrap obs 3
+    o, a, r, d, nobs = out[0]
+    assert o[0] == 0.0 and r == 2.75 and d == np.float32(0.5**3) and nobs[0] == 3.0
+    # Window [1,2,3] ends at terminal: R = 2 + .5*3 + .25*4 = 4.5, discount 0
+    o, a, r, d, _ = out[1]
+    assert o[0] == 1.0 and r == 4.5 and d == 0.0
+    # Flushed partials [2,3] and [3]
+    o, a, r, d, _ = out[2]
+    assert o[0] == 2.0 and r == 3.0 + 0.5 * 4.0 and d == 0.0
+    o, a, r, d, _ = out[3]
+    assert o[0] == 3.0 and r == 4.0 and d == 0.0
+    assert len(out) == 4
+
+
+def test_replay_checkpoint_roundtrip():
+    for cls in (UniformReplay, PrioritizedReplay):
+        buf = cls(capacity=16, obs_dim=2, act_dim=1, seed=0)
+        _fill(buf, 10, obs_dim=2, act_dim=1)
+        if isinstance(buf, PrioritizedReplay):
+            buf.update_priorities(np.arange(10), np.linspace(0.1, 1.0, 10))
+        state = buf.state_dict()
+        fresh = cls(capacity=16, obs_dim=2, act_dim=1, seed=0)
+        fresh.load_state_dict(state)
+        assert len(fresh) == 10
+        np.testing.assert_array_equal(fresh.obs[:10], buf.obs[:10])
+        if isinstance(buf, PrioritizedReplay):
+            np.testing.assert_allclose(
+                fresh._tree.get(np.arange(10)), buf._tree.get(np.arange(10))
+            )
