@@ -1,0 +1,155 @@
+"""Benchmark harness (SURVEY.md §7 step 8; BASELINE.md).
+
+Measures the metric from BASELINE.json:2 — learner grad-steps/sec at
+HalfCheetah-v4 scale (obs 17, act 6, 2x256 MLPs, batch 64, 16-actor
+data pipeline simulated by a pre-filled replay) — for:
+
+  - baseline: the `--backend native` pure-numpy CPU learner, which IS the
+    reference baseline (the reference publishes no numbers, BASELINE.md;
+    its learner is CPU TF on the same algorithm/shapes), and
+  - jax_tpu: the sharded learner on the attached accelerator(s), fed by the
+    production ChunkPrefetcher (sampling + host->HBM transfer included, so
+    this is the honest end-to-end learner rate, not bare FLOPs).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <jax_tpu steps/s>, "unit": "grad_steps/s",
+   "vs_baseline": <jax_tpu / native>}
+
+Env overrides: BENCH_PLATFORM=cpu forces JAX onto host CPU (smoke-testing);
+BENCH_SECONDS scales measurement length.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+OBS_DIM, ACT_DIM = 17, 6
+BATCH = 64
+CHUNK = 100          # learner steps per dispatch (lax.scan)
+NATIVE_STEPS = 400
+
+
+def _config():
+    from distributed_ddpg_tpu.config import DDPGConfig
+
+    return DDPGConfig(
+        env_id="HalfCheetah-v4",
+        actor_hidden=(256, 256),
+        critic_hidden=(256, 256),
+        batch_size=BATCH,
+        num_actors=16,
+        replay_capacity=200_000,
+    )
+
+
+def _fill_replay(config, n=100_000):
+    from distributed_ddpg_tpu.replay import UniformReplay
+
+    replay = UniformReplay(config.replay_capacity, OBS_DIM, ACT_DIM, seed=0)
+    rng = np.random.default_rng(0)
+    bs = 10_000
+    for _ in range(n // bs):
+        replay.add_batch(
+            rng.standard_normal((bs, OBS_DIM)).astype(np.float32),
+            rng.uniform(-1, 1, (bs, ACT_DIM)).astype(np.float32),
+            rng.standard_normal(bs).astype(np.float32),
+            np.full(bs, 0.99, np.float32),
+            rng.standard_normal((bs, OBS_DIM)).astype(np.float32),
+        )
+    return replay
+
+
+def bench_native(config, replay) -> float:
+    import jax
+
+    from distributed_ddpg_tpu.learner import init_train_state
+    from distributed_ddpg_tpu.native_backend import NativeLearner
+
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state = init_train_state(config, OBS_DIM, ACT_DIM, seed=0)
+    learner = NativeLearner(config, state, action_scale=1.0)
+    for _ in range(20):  # warmup (BLAS thread pools etc.)
+        learner.step(replay.sample(BATCH))
+    t0 = time.perf_counter()
+    for _ in range(NATIVE_STEPS):
+        learner.step(replay.sample(BATCH))
+    return NATIVE_STEPS / (time.perf_counter() - t0)
+
+
+def bench_jax(config, replay, seconds: float) -> float:
+    """Steady-state learner rate on the device-resident replay path
+    (replay/device.py): sampling is fused into the scanned chunk, and the
+    only h2d traffic is the actor ingest stream, modeled at the 16-actor
+    MuJoCo rate (~8k transitions/sec) and INCLUDED in the measured loop."""
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    learner = ShardedLearner(
+        config, OBS_DIM, ACT_DIM, action_scale=1.0, chunk_size=CHUNK
+    )
+    device_replay = DeviceReplay(
+        config.replay_capacity, OBS_DIM, ACT_DIM, mesh=learner.mesh, block_size=4096
+    )
+    # Initial fill mirroring the host replay contents (warm buffer).
+    idx = np.arange(100_000)
+    device_replay.add_packed(pack_batch_np(replay.gather(idx)))
+
+    rng = np.random.default_rng(1)
+    ingest_rows = rng.standard_normal((4096, device_replay.width)).astype(np.float32)
+    actor_rate = 8_000.0  # transitions/sec from 16 MuJoCo actors
+
+    # Warmup: compile + first dispatch.
+    out = learner.run_sample_chunk(device_replay)
+    _ = float(out.metrics["critic_loss"])  # sync
+
+    steps = 0
+    ingested = 0.0
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        out = learner.run_sample_chunk(device_replay)
+        steps += CHUNK
+        # Ship actor blocks at the modeled ingest rate.
+        due = (time.perf_counter() - t0) * actor_rate
+        while ingested + 4096 <= due:
+            device_replay.add_packed(ingest_rows)
+            ingested += 4096
+    _ = float(out.metrics["critic_loss"])  # sync on the last chunk
+    elapsed = time.perf_counter() - t0
+    return steps / elapsed
+
+
+def main() -> None:
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    seconds = float(os.environ.get("BENCH_SECONDS", "20"))
+
+    config = _config()
+    replay = _fill_replay(config)
+    native_rate = bench_native(config, replay)
+    jax_rate = bench_jax(config, replay, seconds)
+
+    print(
+        json.dumps(
+            {
+                "metric": "learner_grad_steps_per_sec (HalfCheetah-v4 scale, "
+                "2x256 MLPs, batch 64, replay-fed)",
+                "value": round(jax_rate, 1),
+                "unit": "grad_steps/s",
+                "vs_baseline": round(jax_rate / native_rate, 2),
+                "baseline_native_cpu": round(native_rate, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

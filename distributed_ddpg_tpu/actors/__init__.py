@@ -1,0 +1,4 @@
+from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
+from distributed_ddpg_tpu.actors.pool import ActorPool
+
+__all__ = ["ActorPool", "NumpyPolicy", "flatten_params", "param_layout"]

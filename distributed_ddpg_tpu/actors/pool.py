@@ -1,0 +1,194 @@
+"""ActorPool: N async rollout workers feeding one learner (SURVEY.md §1's
+"N worker processes ... and 1+ PS processes" topology, minus the PS — params
+flow learner->workers through shared memory instead of gRPC pulls).
+
+- Param broadcast: one flat f32 shared-memory array + a version counter.
+  Workers poll the version each env step and memcpy on change — the
+  TPU-native replacement for the reference's per-step parameter pull
+  (SURVEY.md §3.2 'pulls current theta from PS').
+- Transitions: workers push batched n-step transitions over an mp.Queue;
+  `drain_into(replay)` moves them into the host replay buffer.
+- Failure detection (SURVEY.md §5): workers stamp heartbeats; `monitor()`
+  respawns any worker silent past the timeout (actors are stateless given
+  params, so a respawn is lossless except the in-flight episode).
+
+Uses the 'spawn' start method: workers must never inherit the parent's JAX
+runtime state.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_mod
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from distributed_ddpg_tpu.actors.policy import (
+    flatten_params,
+    layout_size,
+    param_layout,
+)
+from distributed_ddpg_tpu.actors.worker import run_worker
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.envs.registry import EnvSpec
+
+
+class ActorPool:
+    def __init__(
+        self,
+        config: DDPGConfig,
+        spec: EnvSpec,
+        num_actors: Optional[int] = None,
+        heartbeat_timeout: float = 30.0,
+    ):
+        self.config = config
+        self.spec = spec
+        self.num_actors = num_actors or config.num_actors
+        self.heartbeat_timeout = heartbeat_timeout
+        self._ctx = mp.get_context("spawn")
+        self.layout = param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden))
+        self._shared = self._ctx.Array("f", layout_size(self.layout), lock=False)
+        self._version = self._ctx.Value("l", 0)
+        self._queue = self._ctx.Queue(maxsize=4 * self.num_actors)
+        self._episodes = self._ctx.Queue(maxsize=16 * self.num_actors)
+        self._heartbeat = self._ctx.Array("d", self.num_actors, lock=False)
+        self._stop = self._ctx.Value("b", 0)
+        self._procs: List[Optional[mp.Process]] = [None] * self.num_actors
+        self._respawns = 0
+        self._steps_received = 0
+
+    # --- lifecycle ---
+
+    def _spawn(self, worker_id: int) -> None:
+        fault_step = 0
+        if self.config.inject_fault.startswith("actor:"):
+            # "actor:<id>:<step>" — crash worker <id> at env step <step>.
+            _, wid, step = self.config.inject_fault.split(":")
+            if int(wid) == worker_id:
+                fault_step = int(step)
+        p = self._ctx.Process(
+            target=run_worker,
+            kwargs=dict(
+                worker_id=worker_id,
+                env_id=self.config.env_id,
+                seed=self.config.seed + 1000 * (worker_id + 1) + self._respawns,
+                layout=self.layout,
+                action_scale=self.spec.action_scale,
+                action_offset=self.spec.action_offset,
+                action_low=self.spec.action_low,
+                action_high=self.spec.action_high,
+                shared_params=self._shared,
+                param_version=self._version,
+                transition_queue=self._queue,
+                heartbeat=self._heartbeat,
+                stop_flag=self._stop,
+                ou_theta=self.config.ou_theta,
+                ou_sigma=self.config.ou_sigma,
+                ou_dt=self.config.ou_dt,
+                n_step=self.config.n_step,
+                gamma=self.config.gamma,
+                fault_step=fault_step,
+                episode_queue=self._episodes,
+            ),
+            daemon=True,
+            name=f"actor-{worker_id}",
+        )
+        p.start()
+        self._heartbeat[worker_id] = time.time()
+        self._procs[worker_id] = p
+
+    def start(self, actor_params) -> "ActorPool":
+        self.broadcast(actor_params)
+        for i in range(self.num_actors):
+            self._spawn(i)
+        return self
+
+    def stop(self) -> None:
+        self._stop.value = 1
+        deadline = time.time() + 5.0
+        for p in self._procs:
+            if p is not None:
+                p.join(timeout=max(0.1, deadline - time.time()))
+        for p in self._procs:
+            if p is not None and p.is_alive():
+                p.terminate()
+
+    # --- param broadcast (learner -> workers) ---
+
+    def broadcast(self, actor_params) -> None:
+        """Seqlock write (SURVEY.md §5 'Race detection'): version goes ODD
+        while the flat array is being written, EVEN when it is consistent.
+        Workers copy only at even versions and re-check the version after
+        the copy, so a torn half-old/half-new parameter vector is never
+        acted on."""
+        flat = flatten_params(actor_params)
+        view = np.frombuffer(self._shared, dtype=np.float32)
+        self._version.value += 1   # odd: write in progress
+        view[:] = flat
+        self._version.value += 1   # even: consistent
+
+    # --- experience (workers -> replay) ---
+
+    def drain_into(self, replay, max_batches: int = 1000) -> int:
+        """Move queued transition batches into replay; returns transitions moved."""
+        moved = 0
+        for _ in range(max_batches):
+            try:
+                _, batch = self._queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            replay.add_batch(
+                batch["obs"],
+                batch["action"],
+                batch["reward"],
+                batch["discount"],
+                batch["next_obs"],
+            )
+            moved += len(batch["reward"])
+        self._steps_received += moved
+        return moved
+
+    def drain_batches(self, max_batches: int = 1000) -> List[Dict[str, np.ndarray]]:
+        """Pop queued transition batches raw (for the device-replay ingest
+        path, which packs them itself); returns a list of field dicts."""
+        out = []
+        for _ in range(max_batches):
+            try:
+                _, batch = self._queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            out.append(batch)
+            self._steps_received += len(batch["reward"])
+        return out
+
+    def episode_stats(self) -> List[tuple]:
+        out = []
+        while True:
+            try:
+                out.append(self._episodes.get_nowait())
+            except queue_mod.Empty:
+                return out
+
+    # --- failure detection / elastic recovery (SURVEY.md §5) ---
+
+    def monitor(self) -> Dict[str, int]:
+        """Respawn workers that died or went silent. Call periodically."""
+        now = time.time()
+        respawned = 0
+        for i, p in enumerate(self._procs):
+            dead = p is None or not p.is_alive()
+            silent = now - self._heartbeat[i] > self.heartbeat_timeout
+            if dead or silent:
+                if p is not None and p.is_alive():
+                    p.terminate()
+                    p.join(timeout=2.0)
+                self._respawns += 1
+                respawned += 1
+                self._spawn(i)
+        return {"respawned": respawned, "total_respawns": self._respawns}
+
+    @property
+    def steps_received(self) -> int:
+        return self._steps_received

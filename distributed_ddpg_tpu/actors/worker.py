@@ -1,0 +1,148 @@
+"""Rollout worker process (SURVEY.md §3.2's per-worker episode loop).
+
+Each worker owns: one env, one OU noise process (per-worker instance, reset
+per episode — SURVEY.md §2 #6), one n-step accumulator, and a numpy policy
+refreshed from the shared-memory param buffer. It streams n-step transitions
+back in batches over an mp.Queue and stamps a heartbeat every loop so the
+pool's monitor can respawn it if it dies (SURVEY.md §5 'Failure detection';
+the reference has none — a dead TF worker just stalls).
+
+Workers never import jax (see policy.py). `fault_step > 0` makes the worker
+crash at that env step — the fault-injection hook (config.inject_fault).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def run_worker(
+    worker_id: int,
+    env_id: str,
+    seed: int,
+    layout,
+    action_scale,
+    action_offset,
+    action_low,
+    action_high,
+    shared_params,          # mp.Array('f'), flat actor params
+    param_version,          # mp.Value('l')
+    transition_queue,       # mp.Queue
+    heartbeat,              # mp.Array('d', num_workers)
+    stop_flag,              # mp.Value('b')
+    ou_theta: float,
+    ou_sigma: float,
+    ou_dt: float,
+    n_step: int,
+    gamma: float,
+    send_every: int = 32,
+    fault_step: int = 0,
+    episode_queue=None,     # optional mp.Queue for (worker_id, return, length)
+) -> None:
+    # Workers are CPU-only by construction; make BLAS behave in many procs.
+    os.environ.setdefault("OMP_NUM_THREADS", "1")
+
+    from distributed_ddpg_tpu.actors.policy import NumpyPolicy
+    from distributed_ddpg_tpu.envs import make
+    from distributed_ddpg_tpu.ops.noise import OUNoise
+    from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+
+    env = make(env_id, seed=seed)
+    act_dim = len(np.atleast_1d(action_low))
+    policy = NumpyPolicy(layout, action_scale, action_offset)
+    noise = OUNoise((act_dim,), theta=ou_theta, sigma=ou_sigma, dt=ou_dt, seed=seed)
+    nstep = NStepAccumulator(n_step, gamma)
+    flat_view = np.frombuffer(shared_params, dtype=np.float32)
+    flat_scratch = np.empty_like(flat_view)
+    seen_version = -1
+
+    pending: list = []
+
+    def maybe_refresh():
+        """Seqlock read (see ActorPool.broadcast): snapshot to scratch while
+        the version is even, and install into the live policy only if the
+        version did not move during the copy — a torn snapshot is discarded
+        and the previous consistent params keep acting until the next step."""
+        nonlocal seen_version
+        v = param_version.value
+        if v == seen_version or v % 2 == 1:
+            return
+        flat_scratch[:] = flat_view
+        if param_version.value == v:
+            policy.load_flat(flat_scratch)
+            seen_version = v
+
+    def flush():
+        if not pending:
+            return
+        batch = {
+            "obs": np.stack([p[0] for p in pending]),
+            "action": np.stack([p[1] for p in pending]),
+            "reward": np.asarray([p[2] for p in pending], np.float32),
+            "discount": np.asarray([p[3] for p in pending], np.float32),
+            "next_obs": np.stack([p[4] for p in pending]),
+        }
+        transition_queue.put((worker_id, batch))
+        pending.clear()
+
+    maybe_refresh()
+    obs, _ = env.reset(seed=seed)
+    noise.reset()
+    ep_return, ep_len, total_steps = 0.0, 0, 0
+
+    while not stop_flag.value:
+        heartbeat[worker_id] = time.time()
+        maybe_refresh()
+        action = policy(obs)[0] + noise() * np.asarray(action_scale, np.float32)
+        action = np.clip(action, action_low, action_high).astype(np.float32)
+        next_obs, reward, terminated, truncated, _ = env.step(action)
+        done = terminated  # truncation bootstraps: discount stays gamma^n
+        pending.extend(
+            nstep.push(obs[None], action[None], [reward], [done], next_obs[None])
+        )
+        ep_return += reward
+        ep_len += 1
+        total_steps += 1
+        obs = next_obs
+
+        if fault_step and total_steps >= fault_step:
+            raise RuntimeError(f"injected fault in worker {worker_id}")
+
+        if terminated or truncated:
+            # Flush the truncation tail through the accumulator so no
+            # experience is stranded, then reset per-episode state.
+            if truncated and not terminated:
+                pending.extend(_flush_truncated(nstep, next_obs))
+            if episode_queue is not None:
+                try:
+                    episode_queue.put_nowait((worker_id, ep_return, ep_len))
+                except Exception:
+                    pass
+            obs, _ = env.reset()
+            noise.reset()
+            nstep.reset()
+            ep_return, ep_len = 0.0, 0
+
+        if len(pending) >= send_every:
+            flush()
+
+    flush()
+
+
+def _flush_truncated(nstep, bootstrap_obs):
+    """Emit the pending partial windows of a TRUNCATED episode. Unlike the
+    terminal flush inside NStepAccumulator.push, these keep a nonzero
+    bootstrap discount (the episode didn't end — time just ran out)."""
+    out = []
+    for e, pend in enumerate(nstep._pending):
+        while pend:
+            o, a, r, disc, nobs = nstep._emit(
+                pend, bootstrap_obs, terminal=False, length=len(pend)
+            )
+            out.append((o, a, r, disc, nobs))
+            pend.popleft()
+    return out

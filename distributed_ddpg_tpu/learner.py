@@ -41,6 +41,19 @@ class StepOutput(NamedTuple):
     metrics: dict
 
 
+# The exact keys of StepOutput.metrics (the dict built in make_learner_step).
+# Sharded wrappers build out-sharding/out-spec pytrees from this, so it must
+# stay in lockstep with the metrics dict below — which is why it lives here.
+METRIC_KEYS = (
+    "critic_loss",
+    "actor_loss",
+    "mean_q",
+    "td_abs_mean",
+    "critic_grad_norm",
+    "actor_grad_norm",
+)
+
+
 def _maybe_psum_mean(tree, axis_name: Optional[str]):
     if axis_name is None:
         return tree
@@ -154,14 +167,22 @@ def make_learner_step(
         new_target_actor = polyak_update(new_actor, state.target_actor_params, config.tau)
         new_target_critic = polyak_update(new_critic, state.target_critic_params, config.tau)
 
-        metrics = {
-            "critic_loss": closs,
-            "actor_loss": aloss,
-            "mean_q": -aloss,
-            "td_abs_mean": jnp.mean(jnp.abs(td)),
-            "critic_grad_norm": optree_norm(cgrads),
-            "actor_grad_norm": optree_norm(agrads),
-        }
+        metrics = dict(
+            zip(
+                METRIC_KEYS,
+                (
+                    closs,
+                    aloss,
+                    -aloss,
+                    jnp.mean(jnp.abs(td)),
+                    optree_norm(cgrads),
+                    optree_norm(agrads),
+                ),
+            )
+        )
+        # Under shard_map each shard sees only its batch slice; average the
+        # scalar diagnostics so every shard reports the global value.
+        metrics = _maybe_psum_mean(metrics, axis_name)
         new_state = TrainState(
             actor_params=new_actor,
             critic_params=new_critic,

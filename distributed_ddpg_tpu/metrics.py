@@ -1,0 +1,65 @@
+"""Structured metrics: JSONL + stdout (SURVEY.md §5 'Metrics / logging').
+
+Replaces the reference's TensorBoard scalar summaries [RECALL] with
+append-only JSONL (one object per event, machine-parseable by the bench
+harness) plus optional human lines. Tracked quantities follow SURVEY.md §5:
+episode return, losses, mean Q, grad norms, buffer fill, actor/learner
+steps/sec, staleness.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: str = "", echo: bool = True):
+        self._file = open(path, "a", buffering=1) if path else None
+        self._echo = echo
+        self._t0 = time.time()
+
+    def log(self, kind: str, step: int, **fields: Any) -> Dict[str, Any]:
+        rec = {
+            "kind": kind,
+            "step": step,
+            "wall_time": round(time.time() - self._t0, 3),
+            **{k: _jsonable(v) for k, v in fields.items()},
+        }
+        line = json.dumps(rec)
+        if self._file:
+            self._file.write(line + "\n")
+        if self._echo:
+            print(line, file=sys.stdout, flush=True)
+        return rec
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+
+
+def _jsonable(v):
+    try:
+        return round(float(v), 6)
+    except (TypeError, ValueError):
+        return v
+
+
+class Timer:
+    """Running steps/sec meter for the actor/learner rate metrics."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t = time.time()
+        self._n = 0
+
+    def tick(self, n: int = 1) -> None:
+        self._n += n
+
+    def rate(self) -> float:
+        dt = time.time() - self._t
+        return self._n / dt if dt > 0 else 0.0

@@ -1,0 +1,8 @@
+from distributed_ddpg_tpu.parallel.mesh import (
+    batch_pspec,
+    make_mesh,
+    state_pspec,
+)
+from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+
+__all__ = ["make_mesh", "state_pspec", "batch_pspec", "ShardedLearner"]

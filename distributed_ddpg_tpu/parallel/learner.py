@@ -1,0 +1,248 @@
+"""The sharded TPU learner (SURVEY.md §7 step 5; BASELINE.json:5's
+"one pmap'd learner step replaces N separate backward passes" — realized
+with the modern jit+sharding idiom instead of pmap).
+
+Two execution modes over the same pure step function (learner.py):
+
+- "auto" (default): `jax.jit` with NamedSharding in/out specs over the
+  (data, model) mesh. Batches shard over 'data'; params/opt-state replicate
+  (or TP-shard over 'model', mesh.py). XLA's SPMD partitioner inserts the
+  gradient AllReduce over ICI — the collective that replaces the
+  reference's async gRPC parameter-server push/pull (SURVEY.md §3.3).
+- "explicit": `jax.shard_map` over the 'data' axis with a hand-written
+  `jax.lax.pmean` in the step (axis_name plumbed through
+  make_learner_step). Data-parallel only; exists to make the collective
+  visible/testable and as the escape hatch if auto partitioning ever
+  mis-schedules.
+
+Both modes expose `run_chunk`: K learner steps per dispatch via `lax.scan`
+over a stacked [K, B, ...] super-batch. One dispatch per K steps amortizes
+host->device latency (critical under this environment's tunneled TPU, and
+free pipelining on real hardware); the donated TrainState never leaves HBM
+between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.learner import (
+    METRIC_KEYS,
+    StepOutput,
+    init_train_state,
+    make_learner_step,
+)
+from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+from distributed_ddpg_tpu.types import (
+    Batch,
+    TrainState,
+    pack_batch_np,
+    unpack_batch,
+)
+
+class ShardedLearner:
+    def __init__(
+        self,
+        config: DDPGConfig,
+        obs_dim: int,
+        act_dim: int,
+        action_scale,
+        action_offset=0.0,
+        mesh: Optional[Mesh] = None,
+        mode: str = "auto",
+        chunk_size: int = 1,
+    ):
+        if mode not in ("auto", "explicit"):
+            raise ValueError(f"mode must be 'auto' or 'explicit', got {mode!r}")
+        self.config = config
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+            config.data_axis, config.model_axis
+        )
+        if mode == "explicit" and self.mesh.shape["model"] != 1:
+            raise ValueError("explicit (shard_map) mode is data-parallel only")
+        self.mode = mode
+        self.chunk_size = int(chunk_size)
+        self.data_size = self.mesh.shape["data"]
+        if config.batch_size % self.data_size:
+            raise ValueError(
+                f"batch_size={config.batch_size} not divisible by data axis "
+                f"size {self.data_size}"
+            )
+
+        self.obs_dim, self.act_dim = obs_dim, act_dim
+        state = init_train_state(config, obs_dim, act_dim, config.seed)
+        self._state_sharding = mesh_lib.to_named(
+            self.mesh, mesh_lib.state_pspec(state, self.mesh)
+        )
+        # Minibatches cross host->HBM as ONE packed [.., B, D] array
+        # (types.pack_batch_np): per-array transfer overhead is the dominant
+        # feed cost, so 6 field arrays -> 1 wire array is a ~10x cut.
+        self._batch_sharding = NamedSharding(self.mesh, P("data", None))
+        self._chunk_sharding = NamedSharding(self.mesh, P(None, "data", None))
+        self.state: TrainState = jax.device_put(state, self._state_sharding)
+
+        if mode == "auto":
+            step = make_learner_step(config, action_scale, action_offset=action_offset)
+        else:
+            inner = make_learner_step(
+                config, action_scale, axis_name="data", action_offset=action_offset
+            )
+            state_spec = mesh_lib.state_pspec(state, self.mesh)
+            bspec = mesh_lib.batch_pspec()
+
+            def step(s: TrainState, b: Batch) -> StepOutput:
+                return jax.shard_map(
+                    inner,
+                    mesh=self.mesh,
+                    in_specs=(state_spec, bspec),
+                    out_specs=StepOutput(
+                        state=state_spec,
+                        td_errors=P("data"),
+                        metrics={k: P() for k in METRIC_KEYS},
+                    ),
+                    check_vma=False,
+                )(s, b)
+
+        replicated = NamedSharding(self.mesh, P())
+        td_sharding = NamedSharding(self.mesh, P("data"))
+
+        def packed_step(s: TrainState, packed):
+            return step(s, unpack_batch(packed, obs_dim, act_dim))
+
+        self._step = jax.jit(
+            packed_step,
+            in_shardings=(self._state_sharding, self._batch_sharding),
+            out_shardings=StepOutput(
+                state=self._state_sharding,
+                td_errors=td_sharding,
+                metrics={k: replicated for k in METRIC_KEYS},
+            ),
+            donate_argnums=(0,),
+        )
+
+        # K-steps-per-dispatch scan (metrics averaged over the chunk).
+        def chunk_fn(s: TrainState, packed):
+            batches = unpack_batch(packed, obs_dim, act_dim)
+
+            def body(carry, b):
+                out = step(carry, b)
+                return out.state, (out.td_errors, out.metrics)
+
+            s, (tds, ms) = jax.lax.scan(body, s, batches)
+            return StepOutput(
+                state=s,
+                td_errors=tds,
+                metrics=jax.tree.map(lambda x: jnp.mean(x), ms),
+            )
+
+        td_chunk_sharding = NamedSharding(self.mesh, P(None, "data"))
+        self._chunk_step = jax.jit(
+            chunk_fn,
+            in_shardings=(self._state_sharding, self._chunk_sharding),
+            out_shardings=StepOutput(
+                state=self._state_sharding,
+                td_errors=td_chunk_sharding,
+                metrics={k: replicated for k in METRIC_KEYS},
+            ),
+            donate_argnums=(0,),
+        )
+
+        # Fused-sampling chunk over a DeviceReplay: K steps per dispatch with
+        # uniform sampling + gather done ON DEVICE — zero h2d inside the
+        # chunk (replay/device.py). PRNG key lives on device too.
+        batch_size = config.batch_size
+
+        def sample_chunk_fn(s: TrainState, key, storage, size):
+            def body(carry, _):
+                st, k = carry
+                k, sub = jax.random.split(k)
+                idx = jax.random.randint(
+                    sub, (batch_size,), 0, jnp.maximum(size, 1)
+                )
+                packed_b = jax.lax.with_sharding_constraint(
+                    storage[idx], NamedSharding(self.mesh, P("data", None))
+                )
+                out = step(st, unpack_batch(packed_b, obs_dim, act_dim))
+                return (out.state, k), (out.td_errors, out.metrics)
+
+            (s, key), (tds, ms) = jax.lax.scan(
+                body, (s, key), None, length=self.chunk_size
+            )
+            return (
+                StepOutput(
+                    state=s,
+                    td_errors=tds,
+                    metrics=jax.tree.map(lambda x: jnp.mean(x), ms),
+                ),
+                key,
+            )
+
+        storage_sharding = NamedSharding(self.mesh, P(None, None))
+        self._sample_chunk_step = jax.jit(
+            sample_chunk_fn,
+            in_shardings=(self._state_sharding, replicated, storage_sharding, replicated),
+            out_shardings=(
+                StepOutput(
+                    state=self._state_sharding,
+                    td_errors=td_chunk_sharding,
+                    metrics={k: replicated for k in METRIC_KEYS},
+                ),
+                replicated,
+            ),
+            donate_argnums=(0, 1),
+        )
+        self._key = jax.device_put(jax.random.PRNGKey(config.seed), replicated)
+
+    # --- single step ---
+
+    def step(self, np_batch: Dict[str, np.ndarray]) -> StepOutput:
+        packed = jax.device_put(pack_batch_np(np_batch), self._batch_sharding)
+        out = self._step(self.state, packed)
+        self.state = out.state
+        return out
+
+    # --- K steps per dispatch ---
+
+    def run_chunk(self, np_batches: Dict[str, np.ndarray]) -> StepOutput:
+        """np_batches fields are [K, B, ...] stacked minibatches."""
+        out = self._chunk_step(self.state, self.put_chunk(np_batches))
+        self.state = out.state
+        return out
+
+    def run_chunk_async(self, device_chunk) -> StepOutput:
+        """Same as run_chunk but takes an already-device_put packed chunk
+        (from the prefetch pipeline) and does not block — callers sync on
+        the outputs."""
+        out = self._chunk_step(self.state, device_chunk)
+        self.state = out.state
+        return out
+
+    def put_chunk(self, np_batches: Dict[str, np.ndarray]):
+        """Pack a [K, B, field] dict into the single wire array and start
+        its (async) transfer to HBM with the chunk sharding."""
+        return jax.device_put(pack_batch_np(np_batches), self._chunk_sharding)
+
+    # --- K steps per dispatch, sampling fused on device ---
+
+    def run_sample_chunk(self, device_replay) -> StepOutput:
+        """K learner steps sampling uniformly from a DeviceReplay — the
+        zero-h2d steady-state path (batches never touch the host)."""
+        storage, size = device_replay.device_state()
+        out, self._key = self._sample_chunk_step(self.state, self._key, storage, size)
+        self.state = out.state
+        return out
+
+    # --- host-side views ---
+
+    def actor_params_to_host(self):
+        """Numpy actor params for broadcast to CPU rollout workers."""
+        return jax.tree.map(np.asarray, jax.device_get(self.state.actor_params))
+
+    def metrics_to_host(self, out: StepOutput) -> Dict[str, float]:
+        return {k: float(v) for k, v in jax.device_get(out.metrics).items()}

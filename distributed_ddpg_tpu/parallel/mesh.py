@@ -1,0 +1,112 @@
+"""Device mesh + sharding specs (replaces the reference's parameter-server
+variable placement, SURVEY.md §2 #10 / §5 'Distributed communication backend').
+
+The reference pins variables to /job:ps and replicates worker graphs over
+gRPC. Here the topology is a `jax.sharding.Mesh` with two named axes:
+
+- `data`: the data-parallel axis. Replay minibatches shard their leading
+  (batch) dim here; XLA turns the per-shard gradient contributions into one
+  AllReduce over ICI (the `psum` the north star names, BASELINE.json:5).
+- `model`: optional tensor parallelism. DDPG's MLPs are far too small to
+  NEED TP (SURVEY.md §2 'Parallelism-strategy inventory' marks it N/A in the
+  reference), but params are plain pytrees so the spec tree below shards
+  hidden dims Megatron-style (alternating column-/row-parallel) when
+  model_axis > 1 — proving the design scales to nets where TP matters.
+
+Multi-host (DCN) uses the SAME mesh/specs: jax.distributed.initialize makes
+jax.devices() span hosts, and XLA routes the collective hierarchically
+(ICI within host, DCN across; SURVEY.md §5 row 'Distributed comm backend').
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ddpg_tpu.types import Batch, OptState, TrainState
+
+
+def make_mesh(
+    data_axis: int = -1,
+    model_axis: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data, model) mesh. data_axis=-1 means 'all remaining devices'."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_axis < 1 or n % model_axis:
+        raise ValueError(f"model_axis={model_axis} must divide device count {n}")
+    if data_axis == -1:
+        data_axis = n // model_axis
+    if data_axis * model_axis != n:
+        raise ValueError(
+            f"mesh {data_axis}x{model_axis} != {n} devices"
+        )
+    arr = np.asarray(devices).reshape(data_axis, model_axis)
+    return Mesh(arr, ("data", "model"))
+
+
+def _layer_pspec(layer_index: int, num_layers: int, kernel_shape, model_size: int):
+    """Megatron-style alternation: even layers column-parallel (shard the
+    output dim), odd layers row-parallel (shard the input dim). The final
+    layer stays replicated (its output dim is act_dim / 1 / num_atoms —
+    tiny and indivisible). Dims that don't divide the model axis stay
+    replicated rather than erroring — XLA would pad, we'd rather not."""
+    in_dim, out_dim = kernel_shape
+    if model_size == 1 or layer_index == num_layers - 1:
+        return {"w": P(None, None), "b": P(None)}
+    if layer_index % 2 == 0:
+        if out_dim % model_size == 0:
+            return {"w": P(None, "model"), "b": P("model")}
+    else:
+        if in_dim % model_size == 0:
+            return {"w": P("model", None), "b": P(None)}
+    return {"w": P(None, None), "b": P(None)}
+
+
+def net_pspec(params, model_size: int):
+    n = len(params)
+    return tuple(
+        _layer_pspec(i, n, params[i]["w"].shape, model_size) for i in range(n)
+    )
+
+
+def state_pspec(state: TrainState, mesh: Mesh) -> TrainState:
+    """PartitionSpec tree mirroring TrainState 1:1. Params (and their Adam
+    moments, which must shard identically) follow net_pspec; scalars
+    replicate."""
+    m = mesh.shape["model"]
+    actor = net_pspec(state.actor_params, m)
+    critic = net_pspec(state.critic_params, m)
+    return TrainState(
+        actor_params=actor,
+        critic_params=critic,
+        target_actor_params=actor,
+        target_critic_params=critic,
+        actor_opt=OptState(mu=actor, nu=actor, count=P()),
+        critic_opt=OptState(mu=critic, nu=critic, count=P()),
+        step=P(),
+    )
+
+
+def batch_pspec() -> Batch:
+    """Minibatches shard their batch dim over 'data' (fields are [B, ...])."""
+    return Batch(
+        obs=P("data", None),
+        action=P("data", None),
+        reward=P("data"),
+        discount=P("data"),
+        next_obs=P("data", None),
+        weight=P("data"),
+    )
+
+
+def to_named(mesh: Mesh, spec_tree):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,67 @@
+"""Multi-host (DCN) initialization (SURVEY.md §7 step 6; BASELINE.json:11-12,
+the v5e-16 'cross-host AllReduce' rung).
+
+The reference's cross-host story is distributed TF's gRPC parameter server
+(SURVEY.md §2 #10). Here it is `jax.distributed.initialize`: after it runs,
+`jax.devices()` spans all hosts, the SAME (data, model) mesh and learner jit
+from parallel/ cover the pod, and XLA lowers the gradient AllReduce
+hierarchically (ICI within a host, DCN across hosts). No framework code
+changes between 1 host and N hosts — only this bootstrap.
+
+Each host runs its own actors and replay shard and feeds its local devices
+(jax makes addressable-device feeding explicit via
+`jax.make_array_from_process_local_data`, used by the prefetcher when
+jax.process_count() > 1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotent jax.distributed bootstrap. Args fall back to the standard
+    env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID,
+    or cloud-TPU auto-detection when none are set). Returns True if a
+    multi-process runtime was initialized, False for single-process runs."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        # Single process (or cloud-TPU metadata auto-detect, which
+        # jax.distributed.initialize() handles with no args — only attempt it
+        # when a TPU runtime is actually present).
+        return False
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return jax.process_count() > 1
+    except RuntimeError as e:
+        if "already initialized" in str(e):
+            return jax.process_count() > 1
+        raise
+
+
+def process_info() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

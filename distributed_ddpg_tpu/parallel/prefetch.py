@@ -1,0 +1,95 @@
+"""Double-buffered host->HBM minibatch pipeline (SURVEY.md §7 step 5 and
+'hard parts (a)': a >=20x-faster learner starves unless sampling + h2d leave
+the step's critical path).
+
+A daemon thread samples K minibatches from replay, stacks them into one
+[K, B, ...] super-batch, and `jax.device_put`s it with the chunk sharding
+(device_put is async — the transfer overlaps the learner's current chunk).
+`depth` bounds the queue: depth=2 is classic double buffering (one chunk in
+compute, one in flight). Sample indices stay host-side and ride along for
+PER priority updates after the chunk's TD errors come back.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ChunkPrefetcher:
+    def __init__(
+        self,
+        replay,
+        put_chunk,                  # ShardedLearner.put_chunk (or any device placer)
+        batch_size: int,
+        chunk_size: int,
+        depth: int = 2,
+        lock: Optional[threading.Lock] = None,
+    ):
+        self._replay = replay
+        self._put = put_chunk
+        self._batch_size = batch_size
+        self._chunk = chunk_size
+        self._lock = lock or threading.Lock()
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True, name="prefetch")
+
+    def start(self) -> "ChunkPrefetcher":
+        self._thread.start()
+        return self
+
+    def _sample_chunk(self) -> Dict[str, np.ndarray]:
+        samples = []
+        with self._lock:
+            for _ in range(self._chunk):
+                samples.append(self._replay.sample(self._batch_size))
+        return {
+            k: np.stack([s[k] for s in samples]) for k in samples[0]
+        }
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                chunk = self._sample_chunk()
+                indices = chunk.pop("indices")
+                device_chunk = self._put(chunk)
+                # Block here (not in get()) when the queue is full — this is
+                # the backpressure that makes `depth` the buffer bound.
+                while not self._stop.is_set():
+                    try:
+                        self._q.put((device_chunk, indices), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surface in next()
+            self._exc = e
+
+    def next(self, timeout: float = 60.0):
+        """Returns (device_chunk, host_indices[K, B]). Re-checks for a dead
+        worker while waiting so its real exception surfaces promptly instead
+        of an unrelated queue timeout."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._exc is not None:
+                raise RuntimeError("prefetch thread died") from self._exc
+            try:
+                return self._q.get(timeout=min(0.5, max(0.0, deadline - time.monotonic())))
+            except queue.Empty:
+                if time.monotonic() >= deadline:
+                    raise
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Drain so the worker unblocks from a full queue, then join.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,163 @@
+"""Device-resident replay: the buffer lives in HBM (SURVEY.md §7 'hard
+parts (a)' taken to its conclusion; Podracer-style, PAPERS.md
+arXiv 2104.06272).
+
+The host-replay + per-chunk-transfer pipeline pays one h2d transfer per
+learner chunk, and transfers that interleave with the execute stream
+serialize against it (measured ~25ms/chunk through a tunneled TPU — 5x the
+chunk's compute). At DDPG scale the WHOLE buffer fits HBM trivially
+(1M transitions x 43 f32 = 172MB on a 16GB v5e), so this module keeps the
+packed [capacity, D] ring in device memory:
+
+  - `insert`: one jitted scatter (mod-capacity wraparound) of a packed
+    [M, D] block; the only steady-state h2d traffic is fresh actor data,
+    in bulk, ~1 transfer per thousands of env steps.
+  - sampling: fused INTO the scanned learner chunk (parallel/learner.py
+    sample_chunk path) — jax.random indices + gather per scan step, so a
+    K-step chunk needs ZERO transfers in and only td/metrics out.
+
+ptr/size/PRNG key live on device; nothing round-trips. Multi-host note:
+storage is replicated over the mesh; insert blocks must be globally
+identical SPMD inputs, so multi-host callers build the global block with
+jax.make_array_from_process_local_data before insert (see
+parallel/multihost.py docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ddpg_tpu.types import packed_width
+
+
+class DeviceReplay:
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int,
+        act_dim: int,
+        mesh: Optional[Mesh] = None,
+        block_size: int = 4096,
+        seed: int = 0,
+    ):
+        self.capacity = int(capacity)
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.block_size = int(block_size)
+        self.width = packed_width(obs_dim, act_dim)
+        self._mesh = mesh
+        sharding = (
+            NamedSharding(mesh, P(None, None)) if mesh is not None else None
+        )
+        scalar_sharding = NamedSharding(mesh, P()) if mesh is not None else None
+        self.storage = jnp.zeros((self.capacity, self.width), jnp.float32)
+        self.ptr = jnp.zeros((), jnp.int32)
+        self.size = jnp.zeros((), jnp.int32)
+        if sharding is not None:
+            self.storage = jax.device_put(self.storage, sharding)
+            self.ptr = jax.device_put(self.ptr, scalar_sharding)
+            self.size = jax.device_put(self.size, scalar_sharding)
+        self._pending = np.zeros((0, self.width), np.float32)
+
+        donate = partial(
+            jax.jit,
+            donate_argnums=(0,),
+            **(
+                dict(
+                    in_shardings=(sharding, sharding, scalar_sharding, scalar_sharding),
+                    out_shardings=(sharding, scalar_sharding, scalar_sharding),
+                )
+                if sharding is not None
+                else {}
+            ),
+        )
+
+        @donate
+        def _insert(storage, block, ptr, size):
+            m = block.shape[0]
+            idx = (ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
+            storage = storage.at[idx].set(block)
+            new_ptr = (ptr + m) % self.capacity
+            new_size = jnp.minimum(size + m, self.capacity)
+            return storage, new_ptr, new_size
+
+        self._insert = _insert
+
+    def __len__(self) -> int:
+        return int(jax.device_get(self.size))
+
+    # --- host -> HBM ingestion ---
+
+    def add_packed(self, block: np.ndarray) -> None:
+        """Buffer packed [M, D] rows host-side; ship in fixed-size blocks
+        (fixed shapes -> one compiled insert, no retrace churn)."""
+        self._pending = np.concatenate([self._pending, block.astype(np.float32)])
+        while len(self._pending) >= self.block_size:
+            chunk, self._pending = (
+                self._pending[: self.block_size],
+                self._pending[self.block_size :],
+            )
+            self._ship(chunk)
+
+    def flush(self, min_rows: int = 1) -> None:
+        """Force pending rows out (padded by repetition to the block shape —
+        only used at warmup / shutdown, so the tiny duplication bias is
+        confined to the first/last block)."""
+        n = len(self._pending)
+        if n >= min_rows and n > 0:
+            reps = -(-self.block_size // n)
+            chunk = np.tile(self._pending, (reps, 1))[: self.block_size]
+            self._pending = np.zeros((0, self.width), np.float32)
+            self._ship(chunk)
+
+    def _ship(self, chunk: np.ndarray) -> None:
+        if self._mesh is not None:
+            chunk = jax.device_put(
+                chunk, NamedSharding(self._mesh, P(None, None))
+            )
+        self.storage, self.ptr, self.size = self._insert(
+            self.storage, chunk, self.ptr, self.size
+        )
+
+    # --- state for the fused sampling learner path ---
+
+    def device_state(self):
+        return self.storage, self.size
+
+    # --- checkpoint support (same contract as host buffers) ---
+
+    def state_dict(self):
+        n = len(self)
+        storage = np.asarray(jax.device_get(self.storage))
+        return {
+            "packed": storage[:n].copy(),
+            "ptr": np.asarray(int(jax.device_get(self.ptr))),
+            "size": np.asarray(n),
+        }
+
+    def load_state_dict(self, state) -> None:
+        n = int(state["size"])
+        if n > self.capacity:
+            raise ValueError(f"checkpointed size {n} exceeds capacity {self.capacity}")
+        storage = np.array(jax.device_get(self.storage))  # writable copy
+        storage[:n] = state["packed"]
+        sharding = (
+            NamedSharding(self._mesh, P(None, None)) if self._mesh is not None else None
+        )
+        self.storage = (
+            jax.device_put(jnp.asarray(storage), sharding)
+            if sharding is not None
+            else jnp.asarray(storage)
+        )
+        self.ptr = jnp.asarray(int(state["ptr"]) % self.capacity, jnp.int32)
+        self.size = jnp.asarray(n, jnp.int32)
+        if self._mesh is not None:
+            scalar = NamedSharding(self._mesh, P())
+            self.ptr = jax.device_put(self.ptr, scalar)
+            self.size = jax.device_put(self.size, scalar)

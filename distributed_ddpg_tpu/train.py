@@ -1,0 +1,324 @@
+"""Training driver + CLI — the reference's `train.py` equivalent
+(SURVEY.md §2 #1, §3.1), re-shaped for the TPU topology.
+
+Where the reference launches {ps|worker} roles over a TF ClusterSpec and
+syncs through gRPC (SURVEY.md §3.1), this driver runs ONE learner process
+(holding the sharded mesh learner) plus N actor subprocesses (ActorPool) —
+params flow through shared memory, gradients through XLA collectives, and
+the only CLI distinction left is `--backend {native,jax_tpu}`
+(BASELINE.json:5): `native` is the pure-CPU numpy baseline, `jax_tpu` the
+sharded JAX path.
+
+Usage:
+    python -m distributed_ddpg_tpu.train --env_id=Pendulum-v1 \
+        --backend=jax_tpu --num_actors=4 --total_env_steps=100000
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from distributed_ddpg_tpu import checkpoint as ckpt_lib
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.envs import make, spec_of
+from distributed_ddpg_tpu.metrics import MetricsLogger, Timer
+from distributed_ddpg_tpu.ops.noise import OUNoise
+from distributed_ddpg_tpu.replay import make_replay
+
+
+def train(config: DDPGConfig) -> Dict[str, float]:
+    if config.backend == "native":
+        return train_native(config)
+    return train_jax(config)
+
+
+# ---------------------------------------------------------------------------
+# --backend native: the measured CPU baseline (BASELINE.md)
+# ---------------------------------------------------------------------------
+
+
+def train_native(config: DDPGConfig) -> Dict[str, float]:
+    from distributed_ddpg_tpu.learner import init_train_state
+    from distributed_ddpg_tpu.native_backend import NativeLearner
+    from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+
+    env = make(config.env_id, seed=config.seed)
+    spec = spec_of(env)
+    # Param init is the only JAX use on the native path; pin it to the host
+    # CPU so the baseline never touches (or waits on) an accelerator.
+    import jax
+
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state = init_train_state(config, spec.obs_dim, spec.act_dim, config.seed)
+    learner = NativeLearner(config, state, spec.action_scale, spec.action_offset)
+    replay = make_replay(config, spec.obs_dim, spec.act_dim)
+    noise = OUNoise(
+        (spec.act_dim,), config.ou_theta, config.ou_sigma, dt=config.ou_dt,
+        seed=config.seed + 1,
+    )
+    nstep = NStepAccumulator(config.n_step, config.gamma)
+    log = MetricsLogger(config.log_path)
+    learn_timer = Timer()
+    learn_steps = 0
+    metrics: Dict[str, float] = {}
+
+    obs, _ = env.reset(seed=config.seed)
+    for step in range(1, config.total_env_steps + 1):
+        action = learner.act(obs)[0] + noise() * spec.action_scale
+        action = np.clip(action, spec.action_low, spec.action_high).astype(np.float32)
+        next_obs, reward, terminated, truncated, _ = env.step(action)
+        for tr in nstep.push(obs[None], action[None], [reward], [terminated], next_obs[None]):
+            replay.add(*tr)
+        obs = next_obs
+        if terminated or truncated:
+            obs, _ = env.reset()
+            noise.reset()
+            nstep.reset()
+        if (
+            len(replay) >= max(config.replay_min_size, config.batch_size)
+            and step % config.train_every == 0
+        ):
+            sample = replay.sample(config.batch_size)
+            indices = sample.pop("indices")
+            m = learner.step(sample)
+            td = m.pop("td_errors")
+            if config.prioritized:
+                replay.update_priorities(indices, td)
+            metrics = m
+            learn_steps += 1
+            learn_timer.tick()
+        if step % max(1, config.eval_every) == 0:
+            log.log(
+                "train", step,
+                learner_steps=learn_steps,
+                learner_steps_per_sec=learn_timer.rate(),
+                buffer_fill=len(replay),
+                **metrics,
+            )
+    rate = learn_timer.rate()
+    log.log("final", config.total_env_steps, learner_steps_per_sec=rate)
+    log.close()
+    return {"learner_steps_per_sec": rate, "learner_steps": learn_steps}
+
+
+# ---------------------------------------------------------------------------
+# --backend jax_tpu: async actors + sharded mesh learner
+# ---------------------------------------------------------------------------
+
+
+def train_jax(config: DDPGConfig) -> Dict[str, float]:
+    import jax
+
+    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
+    from distributed_ddpg_tpu.actors.pool import ActorPool
+    from distributed_ddpg_tpu.parallel import multihost
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.prefetch import ChunkPrefetcher
+
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    multihost.initialize()
+    env = make(config.env_id, seed=config.seed)
+    spec = spec_of(env)
+    chunk = 8  # learner steps per dispatch (lax.scan)
+    learner = ShardedLearner(
+        config,
+        spec.obs_dim,
+        spec.act_dim,
+        spec.action_scale,
+        spec.action_offset,
+        chunk_size=chunk,
+    )
+    # Uniform replay lives ON DEVICE (zero h2d in the steady state,
+    # replay/device.py); PER keeps the host sum-tree + prefetch pipeline
+    # (priorities are host state).
+    use_device_replay = not config.prioritized
+    device_replay = (
+        DeviceReplay(
+            config.replay_capacity,
+            spec.obs_dim,
+            spec.act_dim,
+            mesh=learner.mesh,
+            block_size=1024,
+        )
+        if use_device_replay
+        else None
+    )
+    replay = None if use_device_replay else make_replay(config, spec.obs_dim, spec.act_dim)
+    pool = ActorPool(config, spec)
+    pool.start(learner.actor_params_to_host())
+    log = MetricsLogger(config.log_path)
+    learn_timer, env_timer = Timer(), Timer()
+    learn_steps = 0
+    last_ckpt = 0
+    eval_policy = NumpyPolicy(
+        param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)),
+        spec.action_scale,
+        spec.action_offset,
+    )
+
+    profile_cm = (
+        jax.profiler.trace(config.profile_dir)
+        if config.profile_dir
+        else contextlib.nullcontext()
+    )
+
+    # One lock serializes every host-replay access: the prefetch thread's
+    # sampling vs this thread's inserts and priority updates (SURVEY.md §5
+    # 'Race detection' row — the host buffer is the only shared mutable
+    # state; everything device-side is functional). The device-replay path
+    # has no shared host state at all.
+    replay_lock = threading.Lock()
+
+    def drain() -> int:
+        if use_device_replay:
+            moved = 0
+            batches = pool.drain_batches()
+            for batch in batches:
+                device_replay.add_packed(pack_batch_np(batch))
+                moved += len(batch["reward"])
+            return moved
+        with replay_lock:
+            return pool.drain_into(replay)
+
+    def buffer_fill() -> int:
+        return len(device_replay) if use_device_replay else len(replay)
+
+    next_refresh = 0
+
+    def after_chunk(out, indices) -> None:
+        nonlocal learn_steps, last_ckpt, next_refresh
+        learn_steps += chunk
+        learn_timer.tick(chunk)
+        env_timer.tick(drain())
+
+        if config.prioritized:
+            tds = np.asarray(out.td_errors).reshape(-1)
+            with replay_lock:
+                replay.update_priorities(indices.reshape(-1), tds)
+                frac = min(1.0, pool.steps_received / config.total_env_steps)
+                replay.set_beta(
+                    config.per_beta
+                    + frac * (config.per_beta_final - config.per_beta)
+                )
+
+        # param_refresh_every is in LEARNER STEPS (config.py); refresh on
+        # every crossing of a multiple (chunks advance 8 steps at a time).
+        if learn_steps >= next_refresh:
+            pool.broadcast(learner.actor_params_to_host())
+            next_refresh = learn_steps + config.param_refresh_every
+
+        if learn_steps % (50 * chunk) == 0:
+            pool.monitor()
+            episodes = pool.episode_stats()
+            mean_ret = (
+                float(np.mean([e[1] for e in episodes])) if episodes else None
+            )
+            log.log(
+                "train", pool.steps_received,
+                learner_steps=learn_steps,
+                learner_steps_per_sec=learn_timer.rate(),
+                actor_steps_per_sec=env_timer.rate(),
+                buffer_fill=buffer_fill(),
+                episode_return=mean_ret,
+                **learner.metrics_to_host(out),
+            )
+
+        if (
+            config.checkpoint_dir
+            and learn_steps - last_ckpt >= config.checkpoint_every
+        ):
+            ckpt_lib.save(
+                config.checkpoint_dir, learn_steps, learner.state,
+                device_replay if use_device_replay else replay, config,
+            )
+            last_ckpt = learn_steps
+
+    try:
+        # --- warmup: fill replay to the learning threshold ---
+        min_fill = max(config.replay_min_size, config.batch_size)
+        while buffer_fill() < min_fill:
+            moved = drain()
+            env_timer.tick(moved)
+            pool.monitor()
+            if use_device_replay and moved and buffer_fill() + len(
+                device_replay._pending
+            ) >= min_fill:
+                device_replay.flush()
+            if not moved:
+                time.sleep(0.05)
+
+        prefetch = None
+        if not use_device_replay:
+            prefetch = ChunkPrefetcher(
+                replay, learner.put_chunk, config.batch_size, chunk,
+                depth=config.prefetch_depth, lock=replay_lock,
+            ).start()
+
+        # Rates below report the steady state, not compile/warmup time.
+        learn_timer.reset()
+        env_timer.reset()
+
+        with profile_cm:
+            while pool.steps_received < config.total_env_steps:
+                if use_device_replay:
+                    out = learner.run_sample_chunk(device_replay)
+                    after_chunk(out, None)
+                else:
+                    device_chunk, indices = prefetch.next()
+                    out = learner.run_chunk_async(device_chunk)
+                    after_chunk(out, indices)
+
+        if prefetch is not None:
+            prefetch.stop()
+    finally:
+        pool.stop()
+
+    # --- final eval with the trained policy (CPU, deterministic) ---
+    eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
+    final_return = _eval_numpy(eval_policy, config, spec)
+    rate = learn_timer.rate()
+    log.log(
+        "final", pool.steps_received,
+        learner_steps=learn_steps,
+        learner_steps_per_sec=rate,
+        final_return=final_return,
+    )
+    log.close()
+    return {
+        "learner_steps_per_sec": rate,
+        "learner_steps": learn_steps,
+        "final_return": final_return,
+    }
+
+
+def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None) -> float:
+    env = make(config.env_id, seed=config.seed + 777)
+    returns = []
+    for ep in range(episodes or config.eval_episodes):
+        obs, _ = env.reset(seed=config.seed + 777 + ep)
+        done, total = False, 0.0
+        while not done:
+            action = np.clip(policy(obs)[0], spec.action_low, spec.action_high)
+            obs, r, terminated, truncated, _ = env.step(action)
+            total += r
+            done = terminated or truncated
+        returns.append(total)
+    return float(np.mean(returns))
+
+
+def main(argv=None) -> None:
+    config = DDPGConfig.from_flags(argv if argv is not None else sys.argv[1:])
+    summary = train(config)
+    print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})
+
+
+if __name__ == "__main__":
+    main()

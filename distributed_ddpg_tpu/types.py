@@ -55,3 +55,46 @@ def batch_from_numpy(arrays: Dict[str, np.ndarray]) -> Batch:
         next_obs=arrays["next_obs"],
         weight=arrays.get("weight", np.ones_like(arrays["reward"])),
     )
+
+
+# --- packed-batch wire format -----------------------------------------------
+#
+# Host->device transfers pay a large per-array overhead (worst under a
+# tunneled TPU: ~11ms/array vs ~1ms/MB of payload), so minibatches cross the
+# boundary as ONE [..., B, D] f32 array with fields concatenated on the last
+# axis in this fixed order; `unpack_batch` slices them apart inside jit,
+# where the slices fuse into the consumers for free.
+
+def packed_width(obs_dim: int, act_dim: int) -> int:
+    return 2 * obs_dim + act_dim + 3
+
+
+def pack_batch_np(arrays: Dict[str, np.ndarray]) -> np.ndarray:
+    """[..., B, field] dict -> [..., B, D] packed f32 array (host side)."""
+    reward = np.asarray(arrays["reward"], np.float32)[..., None]
+    discount = np.asarray(arrays["discount"], np.float32)[..., None]
+    weight = arrays.get("weight")
+    weight = (
+        np.ones_like(reward)
+        if weight is None
+        else np.asarray(weight, np.float32)[..., None]
+    )
+    return np.concatenate(
+        [arrays["obs"], arrays["action"], reward, discount, arrays["next_obs"], weight],
+        axis=-1,
+        dtype=np.float32,
+    )
+
+
+def unpack_batch(packed, obs_dim: int, act_dim: int) -> Batch:
+    """Inverse of pack_batch_np; works on jnp arrays inside jit."""
+    o = obs_dim
+    a = act_dim
+    return Batch(
+        obs=packed[..., :o],
+        action=packed[..., o : o + a],
+        reward=packed[..., o + a],
+        discount=packed[..., o + a + 1],
+        next_obs=packed[..., o + a + 2 : 2 * o + a + 2],
+        weight=packed[..., 2 * o + a + 2],
+    )

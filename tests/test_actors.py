@@ -1,0 +1,91 @@
+"""Actor-pool tests (SURVEY.md §4 'Fault/elastic tests'): workers stream
+transitions, param broadcast reaches policies, a killed worker is respawned
+and the learner side keeps running."""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.actors import NumpyPolicy, flatten_params, param_layout
+from distributed_ddpg_tpu.actors.pool import ActorPool
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.envs import make, spec_of
+from distributed_ddpg_tpu.learner import init_train_state
+from distributed_ddpg_tpu.replay import UniformReplay
+
+HID = (16, 16)
+
+
+def _setup(num_actors=2, **kw):
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1",
+        actor_hidden=HID,
+        critic_hidden=HID,
+        num_actors=num_actors,
+        replay_capacity=50_000,
+        **kw,
+    )
+    env = make(cfg.env_id, seed=0, prefer_builtin=True)
+    spec = spec_of(env)
+    state = init_train_state(cfg, spec.obs_dim, spec.act_dim, seed=0)
+    return cfg, spec, state
+
+
+def test_numpy_policy_matches_jax_actor():
+    import jax
+
+    from distributed_ddpg_tpu.learner import make_act_fn
+
+    cfg, spec, state = _setup()
+    layout = param_layout(spec.obs_dim, spec.act_dim, HID)
+    pol = NumpyPolicy(layout, spec.action_scale, spec.action_offset)
+    pol.load_flat(flatten_params(jax.device_get(state.actor_params)))
+    act = make_act_fn(cfg, spec.action_scale, spec.action_offset)
+    obs = np.random.default_rng(0).standard_normal((7, spec.obs_dim)).astype(np.float32)
+    np.testing.assert_allclose(
+        pol(obs), np.asarray(act(state.actor_params, obs)), rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_pool_streams_transitions_and_respawns():
+    cfg, spec, state = _setup(num_actors=2, inject_fault="actor:0:200")
+    replay = UniformReplay(cfg.replay_capacity, spec.obs_dim, spec.act_dim)
+    import jax
+
+    pool = ActorPool(cfg, spec, heartbeat_timeout=15.0)
+    pool.start(jax.device_get(state.actor_params))
+    try:
+        deadline = time.time() + 60
+        while len(replay) < 1000 and time.time() < deadline:
+            pool.drain_into(replay)
+            time.sleep(0.1)
+        assert len(replay) >= 1000, f"only {len(replay)} transitions arrived"
+        # Transitions must be sane Pendulum data.
+        s = replay.sample(64)
+        assert np.all(np.abs(s["action"]) <= 2.0 + 1e-5)
+        assert np.all(s["reward"] <= 0.0)
+        assert np.all((s["discount"] == 0.0) | (s["discount"] > 0.9))
+
+        # Worker 0 crashes at step 200 (injected); monitor must respawn it
+        # and data must keep flowing afterwards.
+        time.sleep(0.5)
+        stats = pool.monitor()
+        deadline = time.time() + 30
+        while stats["total_respawns"] == 0 and time.time() < deadline:
+            time.sleep(0.5)
+            stats = pool.monitor()
+        assert stats["total_respawns"] >= 1, "injected-fault worker never respawned"
+        before = len(replay)
+        deadline = time.time() + 30
+        while len(replay) < before + 200 and time.time() < deadline:
+            pool.drain_into(replay)
+            time.sleep(0.1)
+        assert len(replay) >= before + 200, "no data after respawn"
+
+        # Param broadcast: version bump reaches workers without error.
+        pool.broadcast(jax.device_get(state.actor_params))
+        assert pool.episode_stats() is not None
+    finally:
+        pool.stop()

@@ -1,0 +1,88 @@
+"""Device-resident replay tests: jitted ring insert with wraparound,
+fused-sampling learner chunks (zero h2d), checkpoint roundtrip."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+from distributed_ddpg_tpu.parallel.mesh import make_mesh
+from distributed_ddpg_tpu.replay.device import DeviceReplay
+from distributed_ddpg_tpu.types import pack_batch_np, packed_width
+
+OBS, ACT, B = 4, 2, 64
+W = packed_width(OBS, ACT)
+
+
+def _rows(rng, n):
+    return pack_batch_np(
+        {
+            "obs": rng.standard_normal((n, OBS)).astype(np.float32),
+            "action": rng.uniform(-1, 1, (n, ACT)).astype(np.float32),
+            "reward": rng.standard_normal(n).astype(np.float32),
+            "discount": np.full(n, 0.99, np.float32),
+            "next_obs": rng.standard_normal((n, OBS)).astype(np.float32),
+            "weight": np.ones(n, np.float32),
+        }
+    )
+
+
+def test_insert_and_wraparound():
+    mesh = make_mesh(-1, 1)
+    rep = DeviceReplay(capacity=256, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=64)
+    rng = np.random.default_rng(0)
+    blocks = [_rows(rng, 64) for _ in range(5)]  # 320 rows > capacity 256
+    for b in blocks:
+        rep.add_packed(b)
+    assert len(rep) == 256
+    state = rep.state_dict()
+    assert int(state["ptr"]) == 320 % 256 == 64
+    # Slots 0..63 hold block 4 (wrapped); slots 64..127 hold block 1.
+    stored = np.asarray(jax.device_get(rep.storage))
+    np.testing.assert_allclose(stored[:64], blocks[4], rtol=1e-6)
+    np.testing.assert_allclose(stored[64:128], blocks[1], rtol=1e-6)
+
+
+def test_pending_accumulates_until_block():
+    mesh = make_mesh(-1, 1)
+    rep = DeviceReplay(capacity=256, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=64)
+    rng = np.random.default_rng(1)
+    rep.add_packed(_rows(rng, 30))
+    assert len(rep) == 0 and len(rep._pending) == 30
+    rep.add_packed(_rows(rng, 40))   # 70 total -> one 64-block ships
+    assert len(rep) == 64 and len(rep._pending) == 6
+    rep.flush()
+    assert len(rep) == 128 and len(rep._pending) == 0
+
+
+def test_fused_sampling_chunk():
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B, seed=0
+    )
+    lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, chunk_size=4)
+    rep = DeviceReplay(
+        capacity=1024, obs_dim=OBS, act_dim=ACT, mesh=lrn.mesh, block_size=256
+    )
+    rep.add_packed(_rows(np.random.default_rng(2), 512))
+    out = lrn.run_sample_chunk(rep)
+    assert np.asarray(out.td_errors).shape == (4, B)
+    assert np.isfinite(float(out.metrics["critic_loss"]))
+    assert int(jax.device_get(lrn.state.step)) == 4
+    # Keys advance: two chunks give different losses (different samples).
+    out2 = lrn.run_sample_chunk(rep)
+    assert float(out2.metrics["critic_loss"]) != float(out.metrics["critic_loss"])
+
+
+def test_device_replay_checkpoint_roundtrip():
+    mesh = make_mesh(-1, 1)
+    rep = DeviceReplay(capacity=128, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=32)
+    rep.add_packed(_rows(np.random.default_rng(3), 96))
+    state = rep.state_dict()
+    fresh = DeviceReplay(capacity=128, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=32)
+    fresh.load_state_dict(state)
+    assert len(fresh) == 96
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(fresh.storage))[:96],
+        np.asarray(jax.device_get(rep.storage))[:96],
+    )

@@ -1,0 +1,84 @@
+"""Backend equivalence (SURVEY.md §4 'Backend equivalence'; BASELINE.json:5):
+the numpy `native` path and the jitted JAX path must produce
+tolerance-bounded identical losses, TD errors, and parameter trajectories
+from the same seed and the same replay contents."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.learner import init_train_state, jit_learner_step
+from distributed_ddpg_tpu.native_backend import NativeLearner
+from distributed_ddpg_tpu.types import batch_from_numpy
+
+OBS, ACT, B = 6, 3, 32
+
+
+def _np_batch(rng, weighted=False):
+    return {
+        "obs": rng.standard_normal((B, OBS)).astype(np.float32),
+        "action": rng.uniform(-1, 1, (B, ACT)).astype(np.float32),
+        "reward": rng.standard_normal(B).astype(np.float32),
+        "discount": np.full(B, 0.99, np.float32),
+        "next_obs": rng.standard_normal((B, OBS)).astype(np.float32),
+        "weight": (
+            rng.uniform(0.2, 1.0, B).astype(np.float32)
+            if weighted
+            else np.ones(B, np.float32)
+        ),
+    }
+
+
+@pytest.mark.parametrize("l2,weighted,offset", [(0.0, False, 0.0), (0.01, True, 0.5)])
+def test_native_matches_jax_trajectory(l2, weighted, offset):
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32),
+        critic_hidden=(32, 32),
+        batch_size=B,
+        critic_l2=l2,
+        tau=5e-3,
+    )
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+    native = NativeLearner(cfg, state, action_scale=1.5, action_offset=offset)
+    jstep = jit_learner_step(cfg, 1.5, donate=False, action_offset=offset)
+
+    rng = np.random.default_rng(0)
+    for i in range(10):
+        nb = _np_batch(rng, weighted)
+        out = jstep(state, batch_from_numpy(nb))
+        state = out.state
+        nm = native.step(nb)
+        np.testing.assert_allclose(
+            nm["critic_loss"], float(out.metrics["critic_loss"]), rtol=2e-4,
+            err_msg=f"critic loss diverged at step {i}",
+        )
+        np.testing.assert_allclose(
+            nm["actor_loss"], float(out.metrics["actor_loss"]), rtol=2e-4, atol=1e-5,
+            err_msg=f"actor loss diverged at step {i}",
+        )
+        np.testing.assert_allclose(
+            nm["td_errors"], np.asarray(out.td_errors), rtol=1e-3, atol=1e-4
+        )
+    assert native.params_close_to(state), "param trajectories diverged beyond tolerance"
+    assert native.step_count == int(state.step) == 10
+
+
+def test_native_act_matches_jax():
+    from distributed_ddpg_tpu.learner import make_act_fn
+
+    cfg = DDPGConfig(actor_hidden=(32, 32), critic_hidden=(32, 32))
+    state = init_train_state(cfg, OBS, ACT, seed=1)
+    native = NativeLearner(cfg, state, action_scale=2.0)
+    act = make_act_fn(cfg, 2.0)
+    obs = np.random.default_rng(2).standard_normal((5, OBS)).astype(np.float32)
+    np.testing.assert_allclose(
+        native.act(obs), np.asarray(act(state.actor_params, obs)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_native_rejects_distributional():
+    cfg = DDPGConfig(distributional=True)
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+    with pytest.raises(NotImplementedError):
+        NativeLearner(cfg, state, action_scale=1.0)
