@@ -28,16 +28,37 @@ from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.types import TrainState
 
 
+# Fields that must match between a checkpoint and the run restoring it —
+# shapes/semantics of the restored state depend on them. (orbax restores the
+# CHECKPOINT's shapes regardless of the template, so a silent mismatch here
+# would surface as a crash or corruption far from the root cause.)
+COMPAT_FIELDS = (
+    "env_id",
+    "actor_hidden",
+    "critic_hidden",
+    "action_insert_layer",
+    "distributional",
+    "num_atoms",
+    "prioritized",
+    "replay_capacity",
+    "n_step",
+)
+
+
 def save(
     directory: str,
     step: int,
     state: TrainState,
     replay=None,
     config: Optional[DDPGConfig] = None,
+    env_steps: int = 0,
 ) -> str:
     """Write checkpoint `directory/step_N`. Returns the path."""
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    ckpt: Dict[str, Any] = {"state": jax.device_get(state)}
+    ckpt: Dict[str, Any] = {
+        "state": jax.device_get(state),
+        "meta": {"env_steps": np.asarray(env_steps, np.int64)},
+    }
     if replay is not None:
         ckpt["replay"] = replay.state_dict()
     with ocp.StandardCheckpointer() as ckptr:
@@ -46,6 +67,32 @@ def save(
         with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
             json.dump(dataclasses.asdict(config), f, indent=2, default=list)
     return path
+
+
+def check_config_compatible(directory: str, step: int, config: DDPGConfig) -> None:
+    """Raise ValueError if the checkpoint was written under a config whose
+    COMPAT_FIELDS differ from the current run's."""
+    path = os.path.join(os.path.abspath(directory), f"config_{step}.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        saved = json.load(f)
+    current = dataclasses.asdict(config)
+    mismatches = [
+        f"{k}: checkpoint={saved[k]!r} run={_listify(current[k])!r}"
+        for k in COMPAT_FIELDS
+        if k in saved and saved[k] != _listify(current[k])
+    ]
+    if mismatches:
+        raise ValueError(
+            f"checkpoint {directory}/step_{step} is incompatible with this "
+            "run's config (pass --resume=false or a fresh --checkpoint_dir):\n  "
+            + "\n  ".join(mismatches)
+        )
+
+
+def _listify(v):
+    return list(v) if isinstance(v, tuple) else v
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -64,16 +111,23 @@ def restore(
     state_template: TrainState,
     replay=None,
     step: Optional[int] = None,
-) -> Tuple[TrainState, int]:
-    """Restore (TrainState, step). If `replay` is given its contents are
-    restored in place. `state_template` supplies the tree structure/shapes
-    (orbax restores into abstract targets)."""
+    config: Optional[DDPGConfig] = None,
+) -> Tuple[TrainState, int, int]:
+    """Restore (TrainState, step, env_steps). If `replay` is given its
+    contents are restored in place. `state_template` supplies the tree
+    structure/shapes (orbax restores into abstract targets). When `config`
+    is given, the checkpoint's saved config is validated against it first."""
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
+    if config is not None:
+        check_config_compatible(directory, step, config)
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    template: Dict[str, Any] = {"state": jax.device_get(state_template)}
+    template: Dict[str, Any] = {
+        "state": jax.device_get(state_template),
+        "meta": {"env_steps": np.zeros((), np.int64)},
+    }
     if replay is not None:
         template["replay"] = replay.state_dict()
     with ocp.StandardCheckpointer() as ckptr:
@@ -81,4 +135,5 @@ def restore(
     if replay is not None:
         replay.load_state_dict(restored["replay"])
     state = jax.tree.map(np.asarray, restored["state"])
-    return state, step
+    env_steps = int(restored.get("meta", {}).get("env_steps", 0))
+    return state, step, env_steps

@@ -74,6 +74,7 @@ class DDPGConfig:
     eval_episodes: int = 5
     checkpoint_every: int = 10_000
     checkpoint_dir: str = ""
+    resume: bool = True              # auto-restore latest checkpoint_dir state
     log_path: str = ""               # JSONL metrics path ("" = stdout only)
     profile_dir: str = ""            # jax.profiler trace dir ("" = off)
     inject_fault: str = ""           # fault-injection hook (SURVEY.md §5)

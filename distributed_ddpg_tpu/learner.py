@@ -156,16 +156,29 @@ def make_learner_step(
         aloss, agrads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
         agrads = _maybe_psum_mean(agrads, axis_name)
 
-        new_critic, critic_opt = adam_update(
-            state.critic_params, cgrads, state.critic_opt, config.critic_lr
-        )
-        new_actor, actor_opt = adam_update(
-            state.actor_params, agrads, state.actor_opt, config.actor_lr
-        )
+        if config.fused_update:
+            # Pallas kernel: Adam + Polyak in one VPU pass (ops/fused_update.py).
+            from distributed_ddpg_tpu.ops.fused_update import fused_adam_polyak
 
-        # --- Polyak target updates, fused in (SURVEY.md §3.4) ---
-        new_target_actor = polyak_update(new_actor, state.target_actor_params, config.tau)
-        new_target_critic = polyak_update(new_critic, state.target_critic_params, config.tau)
+            new_critic, critic_opt, new_target_critic = fused_adam_polyak(
+                state.critic_params, cgrads, state.critic_opt,
+                state.target_critic_params, config.critic_lr, config.tau,
+            )
+            new_actor, actor_opt, new_target_actor = fused_adam_polyak(
+                state.actor_params, agrads, state.actor_opt,
+                state.target_actor_params, config.actor_lr, config.tau,
+            )
+        else:
+            new_critic, critic_opt = adam_update(
+                state.critic_params, cgrads, state.critic_opt, config.critic_lr
+            )
+            new_actor, actor_opt = adam_update(
+                state.actor_params, agrads, state.actor_opt, config.actor_lr
+            )
+
+            # --- Polyak target updates, fused in (SURVEY.md §3.4) ---
+            new_target_actor = polyak_update(new_actor, state.target_actor_params, config.tau)
+            new_target_critic = polyak_update(new_critic, state.target_critic_params, config.tau)
 
         metrics = dict(
             zip(

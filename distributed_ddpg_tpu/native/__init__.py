@@ -1,0 +1,123 @@
+"""ctypes bindings for the C++ replay core (replay_core.cpp).
+
+Build-on-demand: first import compiles the shared library with g++ -O3 into
+the user cache dir (fingerprinted by source hash, so edits rebuild). Every
+consumer must tolerate `load() is None` — the numpy implementations in
+replay/sum_tree.py are the always-available fallback; a missing/failed
+toolchain degrades performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from distributed_ddpg_tpu.replay.sum_tree import SumTree
+
+_SRC = os.path.join(os.path.dirname(__file__), "replay_core.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_I64 = ctypes.POINTER(ctypes.c_int64)
+_F64 = ctypes.POINTER(ctypes.c_double)
+
+
+def _cache_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "DDPG_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "distributed_ddpg_tpu_native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir, f"replay_core_{digest}.so")
+
+
+def _build(so_path: str) -> None:
+    tmp = so_path + f".tmp{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, _SRC],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None if unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("DDPG_DISABLE_NATIVE"):
+        return None
+    try:
+        so_path = _cache_path()
+        if not os.path.exists(so_path):
+            _build(so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.st_set.argtypes = [_F64, ctypes.c_int64, _I64, _F64, ctypes.c_int64]
+        lib.st_sample.argtypes = [_F64, ctypes.c_int64, _F64, _I64, ctypes.c_int64]
+        lib.st_get.argtypes = [_F64, ctypes.c_int64, _I64, _F64, ctypes.c_int64]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctype)
+
+
+class NativeSumTree(SumTree):
+    """replay.sum_tree.SumTree with the hot loops (set/get/sample) in C++.
+    Layout, rounding, and stratified sampling are inherited — the numpy
+    class stays the single source of those semantics (and the oracle)."""
+
+    def __init__(self, capacity: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native replay core unavailable")
+        super().__init__(capacity)
+        self._lib = lib
+
+    def set(self, indices, priorities) -> None:
+        idx = np.ascontiguousarray(indices, np.int64)
+        prio = np.ascontiguousarray(priorities, np.float64)
+        self._lib.st_set(
+            _ptr(self.tree, _F64), self.capacity, _ptr(idx, _I64),
+            _ptr(prio, _F64), len(idx),
+        )
+
+    def get(self, indices) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, np.int64)
+        out = np.empty(len(idx), np.float64)
+        self._lib.st_get(
+            _ptr(self.tree, _F64), self.capacity, _ptr(idx, _I64),
+            _ptr(out, _F64), len(idx),
+        )
+        return out
+
+    def sample(self, values) -> np.ndarray:
+        v = np.ascontiguousarray(values, np.float64)
+        out = np.empty(len(v), np.int64)
+        self._lib.st_sample(
+            _ptr(self.tree, _F64), self.capacity, _ptr(v, _F64),
+            _ptr(out, _I64), len(v),
+        )
+        return out
+
+
+def make_sum_tree(capacity: int):
+    """NativeSumTree when the toolchain cooperates, numpy SumTree otherwise."""
+    return NativeSumTree(capacity) if available() else SumTree(capacity)

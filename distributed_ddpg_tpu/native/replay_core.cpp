@@ -1,0 +1,66 @@
+// Native replay core: sum-tree operations for prioritized replay.
+//
+// Role (SURVEY.md §2 note on native components): the reference is pure
+// Python — its only native substrate is stock TensorFlow's C++ runtime. In
+// this framework the device side is XLA-compiled; the remaining host-side
+// hot path is the PER sum-tree, whose per-level numpy vectorization
+// (replay/sum_tree.py) pays O(log C) full-array passes and np.unique calls
+// per batch. These C routines do the same work cache-locally per item and
+// are the backend behind native.NativeSumTree (ctypes; replay/sum_tree.py
+// is the always-available fallback and correctness oracle).
+//
+// Memory contract: Python owns every buffer (numpy arrays) and passes raw
+// pointers; these functions never allocate or free. The tree is the
+// standard 1-indexed layout: leaves at [capacity, 2*capacity), internal
+// node i = sum of children 2i and 2i+1. capacity is a power of two.
+
+#include <cstdint>
+
+extern "C" {
+
+
+// Set leaf priorities and repair ancestor sums. Each item walks its leaf's
+// root path; parents are recomputed from both children, so duplicate
+// indices and shared ancestors converge to correct sums.
+void st_set(double* tree, int64_t capacity, const int64_t* indices,
+            const double* priorities, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t node = capacity + indices[i];
+        tree[node] = priorities[i];
+        node >>= 1;
+        while (node >= 1) {
+            tree[node] = tree[2 * node] + tree[2 * node + 1];
+            node >>= 1;
+        }
+    }
+}
+
+// Descend the tree for each value in [0, total); writes leaf indices.
+void st_sample(const double* tree, int64_t capacity, const double* values,
+               int64_t* out_indices, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+        double v = values[i];
+        int64_t node = 1;
+        while (node < capacity) {
+            int64_t left = 2 * node;
+            double left_sum = tree[left];
+            if (v < left_sum) {
+                node = left;
+            } else {
+                v -= left_sum;
+                node = left + 1;
+            }
+        }
+        out_indices[i] = node - capacity;
+    }
+}
+
+// Gather leaf priorities.
+void st_get(const double* tree, int64_t capacity, const int64_t* indices,
+            double* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = tree[capacity + indices[i]];
+    }
+}
+
+}  // extern "C"

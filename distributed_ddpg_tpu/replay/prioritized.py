@@ -17,7 +17,7 @@ from typing import Dict
 
 import numpy as np
 
-from distributed_ddpg_tpu.replay.sum_tree import SumTree
+from distributed_ddpg_tpu.native import make_sum_tree
 from distributed_ddpg_tpu.replay.uniform import UniformReplay
 
 
@@ -36,7 +36,7 @@ class PrioritizedReplay(UniformReplay):
         self.alpha = alpha
         self.beta = beta
         self.eps = eps
-        self._tree = SumTree(capacity)
+        self._tree = make_sum_tree(capacity)  # C++ core, numpy fallback
         self._max_priority = 1.0
 
     def set_beta(self, beta: float) -> None:

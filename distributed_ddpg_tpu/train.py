@@ -153,11 +153,34 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     )
     replay = None if use_device_replay else make_replay(config, spec.obs_dim, spec.act_dim)
     pool = ActorPool(config, spec)
+    # --- resume (SURVEY.md §3.5/§5: learner restart = checkpoint restore;
+    # unlike the reference, replay contents come back too). The saved config
+    # is validated first; env-step progress carries over so the TOTAL budget
+    # (total_env_steps) spans crashes instead of restarting from zero. ---
+    learn_steps = 0
+    env_steps_offset = 0
+    if (
+        config.resume
+        and config.checkpoint_dir
+        and ckpt_lib.latest_step(config.checkpoint_dir) is not None
+    ):
+        restored, step, env_steps_offset = ckpt_lib.restore(
+            config.checkpoint_dir,
+            learner.state,
+            device_replay if use_device_replay else replay,
+            config=config,
+        )
+        learner.state = jax.device_put(restored, learner._state_sharding)
+        learn_steps = step
+        print(
+            f"resumed from {config.checkpoint_dir} at learner step {step}, "
+            f"env step {env_steps_offset}"
+        )
+
     pool.start(learner.actor_params_to_host())
     log = MetricsLogger(config.log_path)
     learn_timer, env_timer = Timer(), Timer()
-    learn_steps = 0
-    last_ckpt = 0
+    last_ckpt = learn_steps
     eval_policy = NumpyPolicy(
         param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)),
         spec.action_scale,
@@ -191,6 +214,9 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     def buffer_fill() -> int:
         return len(device_replay) if use_device_replay else len(replay)
 
+    def env_steps() -> int:
+        return env_steps_offset + pool.steps_received
+
     next_refresh = 0
 
     def after_chunk(out, indices) -> None:
@@ -203,7 +229,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             tds = np.asarray(out.td_errors).reshape(-1)
             with replay_lock:
                 replay.update_priorities(indices.reshape(-1), tds)
-                frac = min(1.0, pool.steps_received / config.total_env_steps)
+                frac = min(1.0, env_steps() / config.total_env_steps)
                 replay.set_beta(
                     config.per_beta
                     + frac * (config.per_beta_final - config.per_beta)
@@ -222,7 +248,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                 float(np.mean([e[1] for e in episodes])) if episodes else None
             )
             log.log(
-                "train", pool.steps_received,
+                "train", env_steps(),
                 learner_steps=learn_steps,
                 learner_steps_per_sec=learn_timer.rate(),
                 actor_steps_per_sec=env_timer.rate(),
@@ -238,6 +264,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             ckpt_lib.save(
                 config.checkpoint_dir, learn_steps, learner.state,
                 device_replay if use_device_replay else replay, config,
+                env_steps=env_steps(),
             )
             last_ckpt = learn_steps
 
@@ -267,7 +294,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         env_timer.reset()
 
         with profile_cm:
-            while pool.steps_received < config.total_env_steps:
+            while env_steps() < config.total_env_steps:
                 if use_device_replay:
                     out = learner.run_sample_chunk(device_replay)
                     after_chunk(out, None)
@@ -286,7 +313,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     final_return = _eval_numpy(eval_policy, config, spec)
     rate = learn_timer.rate()
     log.log(
-        "final", pool.steps_received,
+        "final", env_steps(),
         learner_steps=learn_steps,
         learner_steps_per_sec=rate,
         final_return=final_return,

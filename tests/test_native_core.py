@@ -1,0 +1,54 @@
+"""C++ replay core vs numpy oracle (SURVEY.md §4 'Unit' + the native-core
+contract in native/__init__.py): identical trees, samples, and totals under
+randomized operation sequences; graceful fallback when disabled."""
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu import native
+from distributed_ddpg_tpu.replay.sum_tree import SumTree
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def test_native_matches_numpy_fuzz():
+    rng = np.random.default_rng(0)
+    a = native.NativeSumTree(100)
+    b = SumTree(100)
+    assert a.capacity == b.capacity
+    for round_ in range(50):
+        n = int(rng.integers(1, 40))
+        idx = rng.integers(0, 100, n)
+        prio = rng.uniform(0.0, 5.0, n)
+        a.set(idx, prio)
+        b.set(idx, prio)
+        np.testing.assert_allclose(a.tree, b.tree, rtol=1e-12, atol=1e-12)
+        v = rng.uniform(0.0, max(a.total, 1e-9), 64)
+        np.testing.assert_array_equal(a.sample(v), b.sample(v))
+        np.testing.assert_allclose(a.get(np.arange(100)), b.get(np.arange(100)))
+
+
+def test_native_stratified_statistics():
+    t = native.NativeSumTree(4)
+    t.set(np.arange(4), np.array([1.0, 0.0, 3.0, 0.0]))
+    rng = np.random.default_rng(1)
+    idx = t.stratified_sample(4000, rng)
+    counts = np.bincount(idx, minlength=4)
+    assert counts[1] == 0 and counts[3] == 0
+    np.testing.assert_allclose(counts[2] / counts[0], 3.0, rtol=0.15)
+
+
+def test_fallback_when_disabled(monkeypatch):
+    import importlib
+
+    monkeypatch.setenv("DDPG_DISABLE_NATIVE", "1")
+    import distributed_ddpg_tpu.native as nat
+
+    importlib.reload(nat)
+    tree = nat.make_sum_tree(16)
+    assert isinstance(tree, SumTree)
+    # Restore the loaded state for other tests.
+    monkeypatch.delenv("DDPG_DISABLE_NATIVE")
+    importlib.reload(nat)

@@ -76,7 +76,7 @@ def test_checkpoint_roundtrip_with_replay(tmp_path):
 
     fresh_replay = PrioritizedReplay(64, 4, 2, seed=1)
     template = init_train_state(cfg, 4, 2, seed=99)
-    restored, step = ckpt_lib.restore(str(tmp_path), template, fresh_replay)
+    restored, step, env_steps = ckpt_lib.restore(str(tmp_path), template, fresh_replay)
     assert step == 42
     assert len(fresh_replay) == 20
     np.testing.assert_array_equal(fresh_replay.reward[:20], replay.reward[:20])
@@ -108,3 +108,57 @@ def test_train_jax_device_replay_path(tmp_path):
     out = train_jax(cfg)
     assert out["learner_steps"] > 0
     assert np.isfinite(out["final_return"])
+
+
+def test_checkpoint_roundtrip_device_replay(tmp_path):
+    """Restore must work into a fresh (empty) DeviceReplay template — the
+    resume path in train_jax."""
+    from distributed_ddpg_tpu.parallel.mesh import make_mesh
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    cfg = DDPGConfig(actor_hidden=(16, 16), critic_hidden=(16, 16))
+    state = init_train_state(cfg, 4, 2, seed=0)
+    mesh = make_mesh(-1, 1)
+    rep = DeviceReplay(128, 4, 2, mesh=mesh, block_size=32)
+    rng = np.random.default_rng(0)
+    rep.add_packed(
+        pack_batch_np(
+            {
+                "obs": rng.standard_normal((64, 4)).astype(np.float32),
+                "action": rng.standard_normal((64, 2)).astype(np.float32),
+                "reward": rng.standard_normal(64).astype(np.float32),
+                "discount": np.full(64, 0.99, np.float32),
+                "next_obs": rng.standard_normal((64, 4)).astype(np.float32),
+            }
+        )
+    )
+    ckpt_lib.save(str(tmp_path), 7, state, rep, cfg)
+
+    fresh = DeviceReplay(128, 4, 2, mesh=mesh, block_size=32)
+    template = init_train_state(cfg, 4, 2, seed=9)
+    restored, step, env_steps = ckpt_lib.restore(str(tmp_path), template, fresh)
+    assert step == 7 and len(fresh) == 64
+    import jax
+
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(fresh.storage))[:64],
+        np.asarray(jax.device_get(rep.storage))[:64],
+    )
+
+
+def test_restore_rejects_incompatible_config(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(16, 16), critic_hidden=(16, 16))
+    state = init_train_state(cfg, 4, 2, seed=0)
+    ckpt_lib.save(str(tmp_path), 5, state, None, cfg, env_steps=1234)
+    # Same config restores fine and carries env_steps.
+    _, step, env_steps = ckpt_lib.restore(
+        str(tmp_path), init_train_state(cfg, 4, 2, seed=1), config=cfg
+    )
+    assert step == 5 and env_steps == 1234
+    # Changed architecture must be rejected with a named mismatch.
+    bad = DDPGConfig(actor_hidden=(32, 32), critic_hidden=(16, 16))
+    with pytest.raises(ValueError, match="actor_hidden"):
+        ckpt_lib.restore(
+            str(tmp_path), init_train_state(bad, 4, 2, seed=1), config=bad
+        )
