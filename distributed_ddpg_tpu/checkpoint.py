@@ -39,6 +39,8 @@ COMPAT_FIELDS = (
     "action_insert_layer",
     "distributional",
     "num_atoms",
+    "v_min",
+    "v_max",
     "prioritized",
     "replay_capacity",
     "n_step",
@@ -131,7 +133,14 @@ def restore(
     if replay is not None:
         template["replay"] = replay.state_dict()
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, template)
+        try:
+            restored = ckptr.restore(path, template)
+        except ValueError:
+            # Checkpoints written before the 'meta' entry existed: orbax
+            # requires the template tree to match the on-disk tree exactly,
+            # so retry without it (env_steps then resumes as 0).
+            template.pop("meta")
+            restored = ckptr.restore(path, template)
     if replay is not None:
         replay.load_state_dict(restored["replay"])
     state = jax.tree.map(np.asarray, restored["state"])

@@ -57,7 +57,10 @@ class DDPGConfig:
 
     # --- distributed topology ---
     num_actors: int = 1
-    backend: str = "jax_tpu"         # {"native", "jax_tpu"} (BASELINE.json:5)
+    # {"native", "jax_tpu", "jax_ondevice"} (BASELINE.json:5). jax_ondevice
+    # runs env physics + replay + learner fused in one XLA program
+    # (ondevice.py); num_actors then means on-device vector envs.
+    backend: str = "jax_tpu"
     data_axis: int = -1              # -1: all devices on data axis
     model_axis: int = 1              # tensor-parallel degree over hidden dims
     train_every: int = 1             # env steps between learner steps (sync mode)
@@ -110,8 +113,11 @@ class DDPGConfig:
         return cls(**vars(args))
 
     def __post_init__(self):
-        if self.backend not in ("native", "jax_tpu"):
-            raise ValueError(f"backend must be 'native' or 'jax_tpu', got {self.backend!r}")
+        if self.backend not in ("native", "jax_tpu", "jax_ondevice"):
+            raise ValueError(
+                "backend must be 'native', 'jax_tpu', or 'jax_ondevice', "
+                f"got {self.backend!r}"
+            )
         if self.n_step < 1:
             raise ValueError("n_step must be >= 1")
         if not 0 <= self.action_insert_layer <= len(self.critic_hidden):

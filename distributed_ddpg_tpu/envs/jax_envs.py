@@ -1,0 +1,129 @@
+"""On-device (JAX) environments — the TPU-native extension of SURVEY.md §1's
+'Environment' row.
+
+The reference (and the `jax_tpu` backend here) steps CPU envs in worker
+processes. For envs whose dynamics are a few FLOPs of arithmetic, that
+topology leaves the accelerator idle between batches; these implementations
+express the dynamics as pure JAX functions so the WHOLE actor-learner loop —
+policy forward, exploration noise, env physics, replay insert, learner
+update — compiles into one XLA program (ondevice.py). vmap supplies the
+batch dimension: one `step` call advances E envs in lockstep on the MXU/VPU.
+
+API (functional, scan/vmap-friendly; no Python state):
+  env.init(key)            -> state pytree (single env)
+  env.step(state, u, key)  -> StepOut(state, obs, boot_obs, reward, done)
+                              with AUTO-RESET: when an episode ends, `state`
+                              is already the reset state and `obs` its first
+                              observation (what the policy acts on next),
+                              while `boot_obs` is the PRE-reset next
+                              observation — the correct bootstrap target for
+                              the stored transition (time-limit truncation
+                              keeps bootstrapping; conflating the two would
+                              bootstrap across the episode boundary).
+  env.observe(state)       -> obs
+
+JaxPendulum mirrors the builtin numpy Pendulum (envs/pendulum.py) equation
+for equation — g=10, m=1, l=1, dt=0.05, max_torque=2, max_speed=8,
+200-step time limit — asserted by tests/test_jax_env.py, so `Pendulum-v1`
+results are comparable across all three backends.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StepOut(NamedTuple):
+    state: "PendulumState"    # post-step state (reset already applied if done)
+    obs: jnp.ndarray          # observation of `state` (policy input)
+    boot_obs: jnp.ndarray     # pre-reset next observation (replay next_obs)
+    reward: jnp.ndarray       # f32[]
+    done: jnp.ndarray         # bool[] episode boundary (truncation included)
+
+
+class PendulumState(NamedTuple):
+    th: jnp.ndarray       # f32[] angle
+    thdot: jnp.ndarray    # f32[] angular velocity
+    t: jnp.ndarray        # i32[] step-in-episode counter
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class JaxPendulum:
+    """Pendulum-v1 dynamics as pure JAX (see module docstring)."""
+
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    l = 1.0
+    max_episode_steps = 200
+
+    obs_dim = 3
+    act_dim = 1
+    action_low = np.array([-2.0], np.float32)
+    action_high = np.array([2.0], np.float32)
+
+    def init(self, key) -> PendulumState:
+        high = jnp.array([jnp.pi, 1.0], jnp.float32)
+        th, thdot = jax.random.uniform(key, (2,), jnp.float32, -high, high)
+        return PendulumState(th=th, thdot=thdot, t=jnp.zeros((), jnp.int32))
+
+    def observe(self, s: PendulumState) -> jnp.ndarray:
+        return jnp.stack([jnp.cos(s.th), jnp.sin(s.th), s.thdot]).astype(jnp.float32)
+
+    def step(self, s: PendulumState, action, key):
+        u = jnp.clip(action.reshape(())[None], -self.max_torque, self.max_torque)[0]
+        cost = (
+            _angle_normalize(s.th) ** 2 + 0.1 * s.thdot**2 + 0.001 * u**2
+        )
+        newthdot = s.thdot + (
+            3.0 * self.g / (2.0 * self.l) * jnp.sin(s.th)
+            + 3.0 / (self.m * self.l**2) * u
+        ) * self.dt
+        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
+        newth = s.th + newthdot * self.dt
+        t = s.t + 1
+        done = t >= self.max_episode_steps
+        stepped = PendulumState(th=newth, thdot=newthdot, t=t)
+        # Auto-reset: where the time limit hit, the next state is a fresh
+        # episode start (same distribution as init).
+        fresh = self.init(key)
+        nxt = PendulumState(
+            th=jnp.where(done, fresh.th, newth),
+            thdot=jnp.where(done, fresh.thdot, newthdot),
+            t=jnp.where(done, fresh.t, t),
+        )
+        return StepOut(
+            state=nxt,
+            obs=self.observe(nxt),
+            boot_obs=self.observe(stepped),
+            reward=-cost.astype(jnp.float32),
+            done=done,
+        )
+
+
+_JAX_ENVS = {
+    "Pendulum-v1": JaxPendulum,
+    "builtin/Pendulum-v1": JaxPendulum,
+}
+
+
+def has_jax_env(env_id: str) -> bool:
+    return env_id in _JAX_ENVS
+
+
+def make_jax_env(env_id: str):
+    if env_id not in _JAX_ENVS:
+        raise ValueError(
+            f"no on-device (JAX) implementation for {env_id!r}; available: "
+            f"{sorted(set(_JAX_ENVS))} — use --backend=jax_tpu for CPU envs"
+        )
+    return _JAX_ENVS[env_id]()

@@ -13,7 +13,6 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import tempfile
 from typing import Optional
 
 import numpy as np
@@ -31,11 +30,13 @@ _F64 = ctypes.POINTER(ctypes.c_double)
 def _cache_path() -> str:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    cache_dir = os.environ.get(
-        "DDPG_NATIVE_CACHE",
-        os.path.join(tempfile.gettempdir(), "distributed_ddpg_tpu_native"),
+    # User-private dir (not a world-writable shared /tmp path: the .so is
+    # loaded with CDLL, so a predictable shared path would let another local
+    # user plant code that we then execute).
+    cache_dir = os.environ.get("DDPG_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~/.cache"), "distributed_ddpg_tpu_native"
     )
-    os.makedirs(cache_dir, exist_ok=True)
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
     return os.path.join(cache_dir, f"replay_core_{digest}.so")
 
 

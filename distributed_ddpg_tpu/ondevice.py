@@ -1,0 +1,336 @@
+"""Fully on-device training: env physics, exploration, replay, and learner
+in ONE compiled XLA program per chunk (`--backend=jax_ondevice`).
+
+This is the TPU-native end state of SURVEY.md §7's 'hard part (a)' (feeding
+a 20x-faster learner): for envs with JAX dynamics (envs/jax_envs.py) there
+is nothing left to feed — E vectorized envs, the OU noise process, the
+device-resident replay ring, and the fused learner step all live in the
+same `lax.scan`, so a K-iteration chunk runs K*E env steps and K gradient
+steps with ZERO host<->device transfers inside the chunk (only scalar
+metrics come out). The reference's topology (SURVEY.md §1: N worker
+processes + parameter server over gRPC) needs a process boundary because
+TF-1.x envs and learners can't fuse; on TPU the boundary itself was the
+bottleneck, so this backend removes it rather than reimplementing it.
+
+Semantics per scan iteration:
+  1. OU noise update (theta/sigma/dt from config) on device, per env;
+  2. a = clip(mu(s) + scale * ou, bounds) for all E envs (one MXU matmul);
+  3. vmapped env.step with auto-reset; the stored transition bootstraps on
+     the PRE-reset observation (jax_envs.StepOut.boot_obs);
+  4. scatter the E packed transitions into the replay ring (mod-capacity);
+  5. one learner step on a uniform sample of `batch_size` rows (gated off
+     until `replay_min_size` rows exist — lax.cond, so warmup needs no
+     separate compiled program).
+
+The E envs play the role of the reference's N async actors (config reuses
+`num_actors` for E); the effective replay ratio is E env steps per gradient
+step. Data-parallelism: the minibatch AND the env batch shard over the
+mesh's 'data' axis (envs replicate if E doesn't divide it); params follow
+parallel/mesh.state_pspec (replicated, or TP-sharded when model_axis > 1).
+
+Pendulum note: the only built-in JAX env never terminates (time-limit
+truncation only), so stored discounts are always gamma. Envs with true
+termination must extend StepOut with a `terminated` flag and fold it into
+the discount column here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.envs.jax_envs import make_jax_env
+from distributed_ddpg_tpu.learner import (
+    METRIC_KEYS,
+    init_train_state,
+    make_learner_step,
+)
+from distributed_ddpg_tpu.models.mlp import actor_apply
+from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+from distributed_ddpg_tpu.types import TrainState, packed_width, unpack_batch
+
+
+class Carry(NamedTuple):
+    """Everything the on-device loop owns, as one donated pytree."""
+
+    train: TrainState
+    env_state: object        # vmapped env state pytree, leading dim E
+    obs: jnp.ndarray         # f32[E, obs_dim] current policy observations
+    ou: jnp.ndarray          # f32[E, act_dim] OU noise state
+    ep_ret: jnp.ndarray      # f32[E] running episode returns
+    storage: jnp.ndarray     # f32[capacity, D] packed replay ring
+    ptr: jnp.ndarray         # i32[]
+    size: jnp.ndarray        # i32[]
+    key: jnp.ndarray         # PRNG key
+
+
+class ChunkStats(NamedTuple):
+    metrics: dict            # mean learner metrics over the chunk (f32[])
+    learn_steps: jnp.ndarray # i32[] learner steps actually taken (post-warmup)
+    dones: jnp.ndarray       # bool[K, E] episode boundaries
+    ep_returns: jnp.ndarray  # f32[K, E] episode return where done, else 0
+
+
+class OnDeviceDDPG:
+    def __init__(
+        self,
+        config: DDPGConfig,
+        mesh: Optional[Mesh] = None,
+        chunk_size: int = 64,
+    ):
+        if config.prioritized:
+            raise ValueError(
+                "jax_ondevice backend supports uniform replay only (PER "
+                "priorities are host state; use --backend=jax_tpu)"
+            )
+        if config.n_step != 1:
+            raise ValueError(
+                "jax_ondevice backend stores 1-step transitions (n-step "
+                "windows are a host-accumulator feature; use --backend=jax_tpu)"
+            )
+        self.config = config
+        self.env = make_jax_env(config.env_id)
+        self.num_envs = int(config.num_actors)
+        self.chunk_size = int(chunk_size)
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+            config.data_axis, config.model_axis
+        )
+        data_size = self.mesh.shape["data"]
+        if config.batch_size % data_size:
+            raise ValueError(
+                f"batch_size={config.batch_size} not divisible by data axis "
+                f"size {data_size}"
+            )
+
+        env = self.env
+        E = self.num_envs
+        obs_dim, act_dim = env.obs_dim, env.act_dim
+        self.obs_dim, self.act_dim = obs_dim, act_dim
+        width = packed_width(obs_dim, act_dim)
+        scale = ((env.action_high - env.action_low) / 2.0).astype(np.float32)
+        offset = ((env.action_high + env.action_low) / 2.0).astype(np.float32)
+        self.action_scale, self.action_offset = scale, offset
+        low = jnp.asarray(env.action_low)
+        high = jnp.asarray(env.action_high)
+
+        step_fn = make_learner_step(config, scale, action_offset=offset)
+        cfg = config
+        capacity = cfg.replay_capacity
+        min_fill = max(cfg.replay_min_size, cfg.batch_size)
+
+        # Envs shard over 'data' when divisible; replicate otherwise (their
+        # per-step FLOPs are negligible — sharding them is a bonus, not a need).
+        env_axis = "data" if E % data_size == 0 else None
+        env_spec = P(env_axis)
+
+        def env_step(carry: Carry):
+            key, k_ou, k_env = jax.random.split(carry.key, 3)
+            ou = (
+                carry.ou
+                + cfg.ou_theta * (0.0 - carry.ou) * cfg.ou_dt
+                + cfg.ou_sigma
+                * jnp.sqrt(cfg.ou_dt)
+                * jax.random.normal(k_ou, carry.ou.shape, jnp.float32)
+            )
+            action = jnp.clip(
+                actor_apply(carry.train.actor_params, carry.obs, scale, offset)
+                + ou * scale,
+                low,
+                high,
+            )
+            out = jax.vmap(env.step)(
+                carry.env_state, action, jax.random.split(k_env, E)
+            )
+            # Packed transition rows [E, D] in types.pack_batch_np order.
+            rows = jnp.concatenate(
+                [
+                    carry.obs,
+                    action,
+                    out.reward[:, None],
+                    jnp.full((E, 1), cfg.gamma, jnp.float32),
+                    out.boot_obs,
+                    jnp.ones((E, 1), jnp.float32),
+                ],
+                axis=-1,
+            )
+            idx = (carry.ptr + jnp.arange(E, dtype=jnp.int32)) % capacity
+            storage = carry.storage.at[idx].set(rows)
+            ep_ret = carry.ep_ret + out.reward
+            done_returns = jnp.where(out.done, ep_ret, 0.0)
+            return (
+                Carry(
+                    train=carry.train,
+                    env_state=out.state,
+                    obs=out.obs,
+                    ou=jnp.where(out.done[:, None], 0.0, ou),
+                    ep_ret=jnp.where(out.done, 0.0, ep_ret),
+                    storage=storage,
+                    ptr=(carry.ptr + E) % capacity,
+                    size=jnp.minimum(carry.size + E, capacity),
+                    key=key,
+                ),
+                out.done,
+                done_returns,
+            )
+
+        zero_metrics = {k: jnp.zeros((), jnp.float32) for k in METRIC_KEYS}
+
+        def learn_step(carry: Carry):
+            key, k_sample = jax.random.split(carry.key)
+            idx = jax.random.randint(
+                k_sample, (cfg.batch_size,), 0, jnp.maximum(carry.size, 1)
+            )
+            packed = jax.lax.with_sharding_constraint(
+                carry.storage[idx], NamedSharding(self.mesh, P("data", None))
+            )
+            out = step_fn(carry.train, unpack_batch(packed, obs_dim, act_dim))
+            return carry._replace(train=out.state, key=key), out.metrics
+
+        def maybe_learn(carry: Carry):
+            return jax.lax.cond(
+                carry.size >= min_fill,
+                lambda c: learn_step(c) + (jnp.int32(1),),
+                lambda c: (c, zero_metrics, jnp.int32(0)),
+                carry,
+            )
+
+        def chunk(carry: Carry):
+            def body(c, _):
+                c, done, done_ret = env_step(c)
+                c, metrics, learned = maybe_learn(c)
+                return c, (metrics, learned, done, done_ret)
+
+            carry, (ms, learned, dones, ep_returns) = jax.lax.scan(
+                body, carry, None, length=self.chunk_size
+            )
+            n = jnp.sum(learned)
+            # Mean over the iterations that actually learned (0-safe).
+            metrics = jax.tree.map(
+                lambda x: jnp.sum(x) / jnp.maximum(n, 1).astype(jnp.float32), ms
+            )
+            return carry, ChunkStats(
+                metrics=metrics,
+                learn_steps=n,
+                dones=dones,
+                ep_returns=ep_returns,
+            )
+
+        # --- shardings over the whole carry ---
+        state = init_train_state(config, obs_dim, act_dim, config.seed)
+        state_spec = mesh_lib.state_pspec(state, self.mesh)
+        key = jax.random.PRNGKey(config.seed)
+        k_init, k_run = jax.random.split(key)
+        env_state = jax.vmap(env.init)(jax.random.split(k_init, E))
+        carry = Carry(
+            train=state,
+            env_state=env_state,
+            obs=jax.vmap(env.observe)(env_state),
+            ou=jnp.zeros((E, act_dim), jnp.float32),
+            ep_ret=jnp.zeros((E,), jnp.float32),
+            storage=jnp.zeros((capacity, width), jnp.float32),
+            ptr=jnp.zeros((), jnp.int32),
+            size=jnp.zeros((), jnp.int32),
+            key=k_run,
+        )
+        carry_spec = Carry(
+            train=state_spec,
+            env_state=jax.tree.map(lambda _: env_spec, env_state),
+            obs=P(env_axis, None),
+            ou=P(env_axis, None),
+            ep_ret=P(env_axis),
+            storage=P(None, None),
+            ptr=P(),
+            size=P(),
+            key=P(),
+        )
+        self._carry_sharding = mesh_lib.to_named(self.mesh, carry_spec)
+        stats_spec = ChunkStats(
+            metrics={k: P() for k in METRIC_KEYS},
+            learn_steps=P(),
+            dones=P(None, env_axis),
+            ep_returns=P(None, env_axis),
+        )
+        self._chunk = jax.jit(
+            chunk,
+            in_shardings=(self._carry_sharding,),
+            out_shardings=(
+                self._carry_sharding,
+                mesh_lib.to_named(self.mesh, stats_spec),
+            ),
+            donate_argnums=(0,),
+        )
+        self.carry: Carry = jax.device_put(carry, self._carry_sharding)
+        self._env_steps = 0
+        self._learn_steps = 0
+
+    # --- driving ---
+
+    def run_chunk(self) -> ChunkStats:
+        """K scan iterations = K*E env steps + up-to-K learner steps."""
+        self.carry, stats = self._chunk(self.carry)
+        self._env_steps += self.chunk_size * self.num_envs
+        return stats
+
+    def finalize_stats(self, stats: ChunkStats) -> dict:
+        """Device stats -> host floats (one sync point per chunk)."""
+        host = jax.device_get(stats)
+        self._learn_steps += int(host.learn_steps)
+        dones = np.asarray(host.dones)
+        rets = np.asarray(host.ep_returns)[dones]
+        out = {k: float(v) for k, v in host.metrics.items()}
+        out["episodes"] = int(dones.sum())
+        if rets.size:
+            out["episode_return"] = float(rets.mean())
+        return out
+
+    @property
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    @property
+    def learn_steps(self) -> int:
+        return self._learn_steps
+
+    # --- host-side views (checkpoint / eval) ---
+
+    @property
+    def state(self) -> TrainState:
+        return self.carry.train
+
+    def actor_params_to_host(self):
+        return jax.tree.map(np.asarray, jax.device_get(self.carry.train.actor_params))
+
+    def load_train_state(self, state: TrainState) -> None:
+        state = jax.device_put(state, self._carry_sharding.train)
+        self.carry = self.carry._replace(train=state)
+
+    def replay_state_dict(self) -> dict:
+        n = int(jax.device_get(self.carry.size))
+        storage = np.asarray(jax.device_get(self.carry.storage))
+        return {
+            "packed": storage[:n].copy(),
+            "ptr": np.asarray(int(jax.device_get(self.carry.ptr))),
+            "size": np.asarray(n),
+        }
+
+    def load_replay_state(self, state: dict) -> None:
+        n = int(state["size"])
+        storage = np.array(jax.device_get(self.carry.storage))
+        storage[:n] = state["packed"]
+        self.carry = self.carry._replace(
+            storage=jax.device_put(
+                jnp.asarray(storage), self._carry_sharding.storage
+            ),
+            ptr=jax.device_put(
+                jnp.asarray(int(state["ptr"]) % self.config.replay_capacity, jnp.int32),
+                self._carry_sharding.ptr,
+            ),
+            size=jax.device_put(
+                jnp.asarray(n, jnp.int32), self._carry_sharding.size
+            ),
+        )

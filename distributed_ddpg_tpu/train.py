@@ -35,6 +35,8 @@ from distributed_ddpg_tpu.replay import make_replay
 def train(config: DDPGConfig) -> Dict[str, float]:
     if config.backend == "native":
         return train_native(config)
+    if config.backend == "jax_ondevice":
+        return train_ondevice(config)
     return train_jax(config)
 
 
@@ -105,6 +107,146 @@ def train_native(config: DDPGConfig) -> Dict[str, float]:
     log.log("final", config.total_env_steps, learner_steps_per_sec=rate)
     log.close()
     return {"learner_steps_per_sec": rate, "learner_steps": learn_steps}
+
+
+# ---------------------------------------------------------------------------
+# --backend jax_ondevice: env + replay + learner fused in one XLA program
+# ---------------------------------------------------------------------------
+
+
+def train_ondevice(config: DDPGConfig) -> Dict[str, float]:
+    import jax
+
+    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
+    from distributed_ddpg_tpu.ondevice import OnDeviceDDPG
+    from distributed_ddpg_tpu.parallel import multihost
+
+    multihost.initialize()
+    trainer = OnDeviceDDPG(config)
+    log = MetricsLogger(config.log_path)
+
+    # Resume: the checkpoint contract matches the other backends (TrainState
+    # + replay contents + env-step offset), via a thin adapter for the
+    # carry-resident replay ring.
+    class _ReplayView:
+        def state_dict(self):
+            return trainer.replay_state_dict()
+
+        def load_state_dict(self, d):
+            trainer.load_replay_state(d)
+
+    env_steps_offset = 0
+    last_ckpt = 0
+    if (
+        config.resume
+        and config.checkpoint_dir
+        and ckpt_lib.latest_step(config.checkpoint_dir) is not None
+    ):
+        restored, step, env_steps_offset = ckpt_lib.restore(
+            config.checkpoint_dir,
+            jax.device_get(trainer.state),
+            _ReplayView(),
+            config=config,
+        )
+        trainer.load_train_state(restored)
+        trainer._learn_steps = step
+        last_ckpt = step
+        print(
+            f"resumed from {config.checkpoint_dir} at learner step {step}, "
+            f"env step {env_steps_offset}"
+        )
+
+    spec = _jax_env_spec(trainer)
+    eval_policy = NumpyPolicy(
+        param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)),
+        spec.action_scale,
+        spec.action_offset,
+    )
+    profile_cm = (
+        jax.profiler.trace(config.profile_dir)
+        if config.profile_dir
+        else contextlib.nullcontext()
+    )
+    env_timer, learn_timer = Timer(), Timer()
+    last_eval = 0
+    eval_return = None
+
+    def env_steps() -> int:
+        return env_steps_offset + trainer.env_steps
+
+    # Episode stats are per-chunk and sparse (an episode boundary may fall in
+    # any chunk); aggregate across chunks between log events.
+    episodes_acc, return_acc = 0, []
+
+    with profile_cm:
+        while env_steps() < config.total_env_steps:
+            before = trainer.learn_steps
+            stats = trainer.run_chunk()
+            host = trainer.finalize_stats(stats)
+            env_timer.tick(trainer.chunk_size * trainer.num_envs)
+            learn_timer.tick(trainer.learn_steps - before)
+            episodes_acc += host.pop("episodes", 0)
+            if "episode_return" in host:
+                return_acc.append(host.pop("episode_return"))
+            log_now = trainer.env_steps % (trainer.chunk_size * trainer.num_envs * 10) == 0
+            if env_steps() - last_eval >= config.eval_every:
+                eval_policy.load_flat(flatten_params(trainer.actor_params_to_host()))
+                eval_return = _eval_numpy(eval_policy, config, spec)
+                last_eval = env_steps()
+                log.log("eval", env_steps(), eval_return=eval_return)
+            if log_now:
+                log.log(
+                    "train", env_steps(),
+                    learner_steps=trainer.learn_steps,
+                    env_steps_per_sec=env_timer.rate(),
+                    learner_steps_per_sec=learn_timer.rate(),
+                    episodes=episodes_acc,
+                    episode_return=(
+                        float(np.mean(return_acc)) if return_acc else None
+                    ),
+                    **host,
+                )
+                episodes_acc, return_acc = 0, []
+            if (
+                config.checkpoint_dir
+                and trainer.learn_steps - last_ckpt >= config.checkpoint_every
+            ):
+                ckpt_lib.save(
+                    config.checkpoint_dir, trainer.learn_steps,
+                    jax.device_get(trainer.state), _ReplayView(), config,
+                    env_steps=env_steps(),
+                )
+                last_ckpt = trainer.learn_steps
+
+    eval_policy.load_flat(flatten_params(trainer.actor_params_to_host()))
+    final_return = _eval_numpy(eval_policy, config, spec)
+    rate = env_timer.rate()
+    log.log(
+        "final", env_steps(),
+        learner_steps=trainer.learn_steps,
+        env_steps_per_sec=rate,
+        learner_steps_per_sec=learn_timer.rate(),
+        final_return=final_return,
+    )
+    log.close()
+    return {
+        "env_steps_per_sec": rate,
+        "learner_steps_per_sec": learn_timer.rate(),
+        "learner_steps": trainer.learn_steps,
+        "final_return": final_return,
+    }
+
+
+def _jax_env_spec(trainer):
+    from distributed_ddpg_tpu.envs.registry import EnvSpec
+
+    env = trainer.env
+    return EnvSpec(
+        obs_dim=env.obs_dim,
+        act_dim=env.act_dim,
+        action_low=np.asarray(env.action_low, np.float32),
+        action_high=np.asarray(env.action_high, np.float32),
+    )
 
 
 # ---------------------------------------------------------------------------

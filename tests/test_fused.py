@@ -33,7 +33,7 @@ def test_fused_matches_reference_ops():
     p_r, opt_r, t_r = params, opt, targets
     for i in range(3):
         grads = jax.tree.map(lambda x: jnp.sin(x + i), p_r)
-        p_f, opt_f, t_f = fused_adam_polyak(p_f, jax.tree.map(lambda x: jnp.sin(x + i), p_f), opt_f, t_f, 1e-3, 0.05)
+        p_f, opt_f, t_f = fused_adam_polyak(p_f, grads, opt_f, t_f, 1e-3, 0.05)
         p_r, opt_r = adam_update(p_r, grads, opt_r, 1e-3)
         t_r = polyak_update(p_r, t_r, 0.05)
         for a, b in zip(jax.tree.leaves((p_f, opt_f.mu, opt_f.nu, t_f)),
