@@ -133,14 +133,18 @@ def restore(
     if replay is not None:
         template["replay"] = replay.state_dict()
     with ocp.StandardCheckpointer() as ckptr:
+        # Checkpoints written before the 'meta' entry existed lack that
+        # subtree, and orbax requires the template to match the on-disk tree
+        # exactly. Probe the saved structure rather than catching ValueError,
+        # so genuine template mismatches keep their original diagnostic.
         try:
-            restored = ckptr.restore(path, template)
-        except ValueError:
-            # Checkpoints written before the 'meta' entry existed: orbax
-            # requires the template tree to match the on-disk tree exactly,
-            # so retry without it (env_steps then resumes as 0).
-            template.pop("meta")
-            restored = ckptr.restore(path, template)
+            on_disk = ckptr.metadata(path)
+            has_meta = "meta" in getattr(on_disk, "tree", on_disk)
+        except Exception:
+            has_meta = True  # metadata unreadable: let restore() report it
+        if not has_meta:
+            template.pop("meta")  # env_steps then resumes as 0
+        restored = ckptr.restore(path, template)
     if replay is not None:
         replay.load_state_dict(restored["replay"])
     state = jax.tree.map(np.asarray, restored["state"])

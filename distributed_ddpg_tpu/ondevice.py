@@ -93,6 +93,11 @@ class OnDeviceDDPG:
                 "jax_ondevice backend stores 1-step transitions (n-step "
                 "windows are a host-accumulator feature; use --backend=jax_tpu)"
             )
+        if config.train_every != 1:
+            raise ValueError(
+                "jax_ondevice backend runs one learner step per vector env "
+                "step (train_every is a host-loop knob; use --backend=jax_tpu)"
+            )
         self.config = config
         self.env = make_jax_env(config.env_id)
         self.num_envs = int(config.num_actors)
