@@ -58,6 +58,12 @@ class ActorPool:
         self._procs: List[Optional[mp.Process]] = [None] * self.num_actors
         self._respawns = 0
         self._steps_received = 0
+        # Param-staleness tracking (SURVEY.md §5 'params-staleness per
+        # actor'): even version -> learner step at broadcast, pruned to the
+        # most recent entries; per-worker staleness updated on drain.
+        self._version_steps: Dict[int, int] = {}
+        self._last_broadcast_step = 0
+        self._staleness = np.zeros(self.num_actors, np.int64)
 
     # --- lifecycle ---
 
@@ -117,17 +123,37 @@ class ActorPool:
 
     # --- param broadcast (learner -> workers) ---
 
-    def broadcast(self, actor_params) -> None:
+    def broadcast(self, actor_params, learner_step: int = 0) -> None:
         """Seqlock write (SURVEY.md §5 'Race detection'): version goes ODD
         while the flat array is being written, EVEN when it is consistent.
         Workers copy only at even versions and re-check the version after
         the copy, so a torn half-old/half-new parameter vector is never
-        acted on."""
+        acted on.
+
+        `learner_step` stamps which learner step these params come from so
+        experience can be attributed a staleness (see staleness())."""
         flat = flatten_params(actor_params)
         view = np.frombuffer(self._shared, dtype=np.float32)
         self._version.value += 1   # odd: write in progress
         view[:] = flat
         self._version.value += 1   # even: consistent
+        self._last_broadcast_step = int(learner_step)
+        self._version_steps[self._version.value] = self._last_broadcast_step
+        while len(self._version_steps) > 64:
+            self._version_steps.pop(next(iter(self._version_steps)))
+
+    def _note_version(self, worker_id: int, version: int) -> None:
+        acted_at = self._version_steps.get(version, 0)
+        self._staleness[worker_id] = self._last_broadcast_step - acted_at
+
+    def staleness(self) -> Dict[str, float]:
+        """Learner-step staleness of the params behind each worker's most
+        recently drained experience: 0 = acting on the latest broadcast."""
+        s = self._staleness[: self.num_actors]
+        return {
+            "staleness_mean": float(s.mean()) if len(s) else 0.0,
+            "staleness_max": int(s.max()) if len(s) else 0,
+        }
 
     # --- experience (workers -> replay) ---
 
@@ -136,9 +162,10 @@ class ActorPool:
         moved = 0
         for _ in range(max_batches):
             try:
-                _, batch = self._queue.get_nowait()
+                wid, version, batch = self._queue.get_nowait()
             except queue_mod.Empty:
                 break
+            self._note_version(wid, version)
             replay.add_batch(
                 batch["obs"],
                 batch["action"],
@@ -156,9 +183,10 @@ class ActorPool:
         out = []
         for _ in range(max_batches):
             try:
-                _, batch = self._queue.get_nowait()
+                wid, version, batch = self._queue.get_nowait()
             except queue_mod.Empty:
                 break
+            self._note_version(wid, version)
             out.append(batch)
             self._steps_received += len(batch["reward"])
         return out

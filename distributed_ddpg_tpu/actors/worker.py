@@ -86,7 +86,10 @@ def run_worker(
             "discount": np.asarray([p[3] for p in pending], np.float32),
             "next_obs": np.stack([p[4] for p in pending]),
         }
-        transition_queue.put((worker_id, batch))
+        # seen_version tags which param snapshot produced this experience —
+        # the pool converts it to learner-step staleness (SURVEY.md §5
+        # 'params-staleness per actor').
+        transition_queue.put((worker_id, seen_version, batch))
         pending.clear()
 
     maybe_refresh()

@@ -8,10 +8,14 @@ from parallel/ cover the pod, and XLA lowers the gradient AllReduce
 hierarchically (ICI within a host, DCN across hosts). No framework code
 changes between 1 host and N hosts — only this bootstrap.
 
-Each host runs its own actors and replay shard and feeds its local devices
-(jax makes addressable-device feeding explicit via
-`jax.make_array_from_process_local_data`, used by the prefetcher when
-jax.process_count() > 1).
+Each host runs its own actors and replay shard and feeds its local devices.
+Feeding works unchanged across processes: `jax.device_put` with a global
+NamedSharding places each process's addressable shards (every process must
+call it with the same global array — true here since learner inputs are
+deterministic given the replay contents), and
+`jax.make_array_from_process_local_data` remains the explicit per-host
+alternative. Both paths (and full cross-process learner parity) are
+exercised by tests/test_multihost.py over a 2-process Gloo CPU cluster.
 """
 
 from __future__ import annotations

@@ -360,9 +360,10 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         return env_steps_offset + pool.steps_received
 
     next_refresh = 0
+    last_eval = 0
 
     def after_chunk(out, indices) -> None:
-        nonlocal learn_steps, last_ckpt, next_refresh
+        nonlocal learn_steps, last_ckpt, next_refresh, last_eval
         learn_steps += chunk
         learn_timer.tick(chunk)
         env_timer.tick(drain())
@@ -380,7 +381,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         # param_refresh_every is in LEARNER STEPS (config.py); refresh on
         # every crossing of a multiple (chunks advance 8 steps at a time).
         if learn_steps >= next_refresh:
-            pool.broadcast(learner.actor_params_to_host())
+            pool.broadcast(learner.actor_params_to_host(), learn_steps)
             next_refresh = learn_steps + config.param_refresh_every
 
         if learn_steps % (50 * chunk) == 0:
@@ -396,8 +397,20 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                 actor_steps_per_sec=env_timer.rate(),
                 buffer_fill=buffer_fill(),
                 episode_return=mean_ret,
+                **pool.staleness(),
                 **learner.metrics_to_host(out),
             )
+
+        # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
+        # deterministic CPU rollout of the current policy, off the actors'
+        # exploration path. Runs inline between chunk dispatches.
+        if config.eval_every and env_steps() - last_eval >= config.eval_every:
+            eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
+            log.log(
+                "eval", env_steps(),
+                eval_return=_eval_numpy(eval_policy, config, spec),
+            )
+            last_eval = env_steps()
 
         if (
             config.checkpoint_dir

@@ -1,0 +1,77 @@
+"""Child process for tests/test_multihost.py: one of N processes in a
+jax.distributed CPU cluster (SURVEY.md §4 'Multi-host path tested with
+jax.distributed.initialize across local subprocesses').
+
+Each process contributes 2 fake CPU devices; the global (data=N*2, model=1)
+mesh spans processes, so the learner's gradient AllReduce crosses the
+process boundary (Gloo here, DCN on a real pod — parallel/multihost.py).
+Runs one deterministic learner chunk and prints a parity line the parent
+compares across processes and against a single-process run.
+
+Usage: python multihost_child.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    # Exercise the production bootstrap via its env-var path.
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+
+    from distributed_ddpg_tpu.parallel import multihost
+
+    assert multihost.initialize() is True
+    info = multihost.process_info()
+    assert info["process_count"] == nprocs, info
+    assert info["global_device_count"] == 2 * nprocs, info
+
+    import numpy as np
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+
+    run_parity_chunk(ShardedLearner, DDPGConfig, np, tag=f"proc{pid}")
+
+
+def run_parity_chunk(ShardedLearner, DDPGConfig, np, tag: str) -> None:
+    """Deterministic 2-step chunk at batch 16 over however many devices are
+    visible; prints 'PARITY <tag> <critic_loss> <param_checksum>'."""
+    config = DDPGConfig(
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        batch_size=16,
+        seed=0,
+    )
+    learner = ShardedLearner(config, 5, 2, action_scale=1.0, chunk_size=2)
+    rng = np.random.default_rng(0)
+    k, b = 2, config.batch_size
+    chunk = {
+        "obs": rng.standard_normal((k, b, 5)).astype(np.float32),
+        "action": rng.uniform(-1, 1, (k, b, 2)).astype(np.float32),
+        "reward": rng.standard_normal((k, b)).astype(np.float32),
+        "discount": np.full((k, b), 0.99, np.float32),
+        "next_obs": rng.standard_normal((k, b, 5)).astype(np.float32),
+        "weight": np.ones((k, b), np.float32),
+    }
+    out = learner.run_chunk(chunk)
+    import jax
+
+    loss = float(jax.device_get(out.metrics["critic_loss"]))
+    leaves = jax.tree.leaves(jax.device_get(learner.state.actor_params))
+    checksum = float(sum(np.abs(leaf).sum() for leaf in leaves))
+    print(f"PARITY {tag} {loss:.8f} {checksum:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

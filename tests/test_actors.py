@@ -84,8 +84,16 @@ def test_pool_streams_transitions_and_respawns():
             time.sleep(0.1)
         assert len(replay) >= before + 200, "no data after respawn"
 
-        # Param broadcast: version bump reaches workers without error.
-        pool.broadcast(jax.device_get(state.actor_params))
+        # Param broadcast: version bump reaches workers without error, and
+        # subsequently drained experience carries a bounded staleness
+        # (SURVEY.md §5 'params-staleness per actor').
+        pool.broadcast(jax.device_get(state.actor_params), learner_step=500)
+        deadline = time.time() + 30
+        while pool.drain_into(replay) == 0 and time.time() < deadline:
+            time.sleep(0.1)
+        st = pool.staleness()
+        assert 0 <= st["staleness_mean"] <= 500
+        assert 0 <= st["staleness_max"] <= 500
         assert pool.episode_stats() is not None
     finally:
         pool.stop()

@@ -1,0 +1,91 @@
+"""Real multi-process DCN-path test (SURVEY.md §4: 'Multi-host path tested
+with jax.distributed.initialize across local subprocesses').
+
+Launches 2 subprocesses, each with 2 fake CPU devices, joined into one
+jax.distributed cluster; the learner's (data=4) mesh then spans the process
+boundary, so its gradient AllReduce runs over the cross-process collective
+transport (Gloo on CPU; DCN on a real multi-host pod — the topology of
+BASELINE.md's v5e-16 rung). Asserts:
+
+- both processes complete a full ShardedLearner chunk (global SPMD works),
+- they report bit-identical loss/params (SPMD consistency), and
+- the result matches a single-process 4-device run of the same chunk
+  (cross-process AllReduce computes the same reduction).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+CHILD = Path(__file__).parent / "multihost_child.py"
+REPO = str(CHILD.parent.parent)
+ENV = {**os.environ, "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_learner_parity():
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(CHILD), str(pid), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+            env=ENV,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, f"child failed:\n{out}"
+
+    parity = sorted(
+        line.split()[1:] for o in outs for line in o.splitlines()
+        if line.startswith("PARITY")
+    )
+    assert len(parity) == 2, f"expected 2 parity lines, got {parity}\n{outs}"
+    (_, loss0, sum0), (_, loss1, sum1) = parity
+    assert loss0 == loss1, f"cross-process loss mismatch: {loss0} vs {loss1}"
+    assert sum0 == sum1, f"cross-process param mismatch: {sum0} vs {sum1}"
+
+    # Single-process oracle: same chunk on a 4-device single-process mesh.
+    oracle = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import os; os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=4';"
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import numpy as np;"
+            "from distributed_ddpg_tpu.config import DDPGConfig;"
+            "from distributed_ddpg_tpu.parallel.learner import ShardedLearner;"
+            "from tests.multihost_child import run_parity_chunk;"
+            "run_parity_chunk(ShardedLearner, DDPGConfig, np, tag='single')",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=REPO,
+        env=ENV,
+    )
+    assert oracle.returncode == 0, oracle.stdout + oracle.stderr
+    single = [
+        line.split()[1:]
+        for line in oracle.stdout.splitlines()
+        if line.startswith("PARITY")
+    ][0]
+    _, loss_s, sum_s = single
+    assert abs(float(loss0) - float(loss_s)) < 1e-5, (loss0, loss_s)
+    assert abs(float(sum0) - float(sum_s)) < 1e-3, (sum0, sum_s)
