@@ -38,11 +38,16 @@ import numpy as np
 
 
 class StepOut(NamedTuple):
-    state: "PendulumState"    # post-step state (reset already applied if done)
+    state: object             # post-step state (reset already applied if done)
     obs: jnp.ndarray          # observation of `state` (policy input)
     boot_obs: jnp.ndarray     # pre-reset next observation (replay next_obs)
     reward: jnp.ndarray       # f32[]
     done: jnp.ndarray         # bool[] episode boundary (truncation included)
+    # bool[] TRUE termination (env reached an absorbing state): bootstrap
+    # discount is 0. Time-limit truncation keeps done=True, terminated=False
+    # and keeps bootstrapping. Pendulum only truncates; MountainCar also
+    # terminates at the goal.
+    terminated: jnp.ndarray
 
 
 class PendulumState(NamedTuple):
@@ -107,12 +112,79 @@ class JaxPendulum:
             boot_obs=self.observe(stepped),
             reward=-cost.astype(jnp.float32),
             done=done,
+            terminated=jnp.zeros((), bool),  # Pendulum only truncates
+        )
+
+
+class MountainCarState(NamedTuple):
+    pos: jnp.ndarray      # f32[] position
+    vel: jnp.ndarray      # f32[] velocity
+    t: jnp.ndarray        # i32[] step-in-episode counter
+
+
+class JaxMountainCar:
+    """MountainCarContinuous-v0 dynamics as pure JAX, equation for equation
+    with gymnasium's continuous_mountain_car (power=0.0015, gravity term
+    0.0025*cos(3x), goal at x>=0.45 with vel>=0, +100 terminal reward,
+    -0.1*a^2 action cost, 999-step time limit) — asserted against the real
+    gymnasium env by tests/test_ondevice.py. Unlike Pendulum this env truly
+    TERMINATES, exercising the terminated/truncated split end to end."""
+
+    power = 0.0015
+    gravity = 0.0025
+    min_position = -1.2
+    max_position = 0.6
+    max_speed = 0.07
+    goal_position = 0.45
+    goal_velocity = 0.0
+    max_episode_steps = 999
+
+    obs_dim = 2
+    act_dim = 1
+    action_low = np.array([-1.0], np.float32)
+    action_high = np.array([1.0], np.float32)
+
+    def init(self, key) -> MountainCarState:
+        pos = jax.random.uniform(key, (), jnp.float32, -0.6, -0.4)
+        return MountainCarState(
+            pos=pos, vel=jnp.zeros((), jnp.float32), t=jnp.zeros((), jnp.int32)
+        )
+
+    def observe(self, s: MountainCarState) -> jnp.ndarray:
+        return jnp.stack([s.pos, s.vel]).astype(jnp.float32)
+
+    def step(self, s: MountainCarState, action, key):
+        force = jnp.clip(action.reshape(())[None], -1.0, 1.0)[0]
+        vel = s.vel + force * self.power - self.gravity * jnp.cos(3.0 * s.pos)
+        vel = jnp.clip(vel, -self.max_speed, self.max_speed)
+        pos = jnp.clip(s.pos + vel, self.min_position, self.max_position)
+        vel = jnp.where((pos <= self.min_position) & (vel < 0.0), 0.0, vel)
+        t = s.t + 1
+        terminated = (pos >= self.goal_position) & (vel >= self.goal_velocity)
+        done = terminated | (t >= self.max_episode_steps)
+        reward = jnp.where(terminated, 100.0, 0.0) - 0.1 * force**2
+        stepped = MountainCarState(pos=pos, vel=vel, t=t)
+        fresh = self.init(key)
+        nxt = MountainCarState(
+            pos=jnp.where(done, fresh.pos, pos),
+            vel=jnp.where(done, fresh.vel, vel),
+            t=jnp.where(done, fresh.t, t),
+        )
+        return StepOut(
+            state=nxt,
+            obs=self.observe(nxt),
+            boot_obs=self.observe(stepped),
+            reward=reward.astype(jnp.float32),
+            done=done,
+            terminated=terminated,
         )
 
 
 _JAX_ENVS = {
     "Pendulum-v1": JaxPendulum,
     "builtin/Pendulum-v1": JaxPendulum,
+    "MountainCarContinuous-v0": JaxMountainCar,
+    "builtin/MountainCarContinuous-v0": JaxMountainCar,
 }
 
 

@@ -15,11 +15,20 @@ from typing import NamedTuple
 
 import numpy as np
 
+from distributed_ddpg_tpu.envs.mountain_car import MountainCarContinuous
 from distributed_ddpg_tpu.envs.pendulum import Pendulum
 
 _BUILTIN = {
     "Pendulum-v1": Pendulum,
     "builtin/Pendulum-v1": Pendulum,
+    "MountainCarContinuous-v0": MountainCarContinuous,
+    "builtin/MountainCarContinuous-v0": MountainCarContinuous,
+}
+
+# Gymnasium retires env versions (DeprecatedEnv); keep the BASELINE.md ladder
+# ids working by bumping to the successor when the pinned version is gone.
+_VERSION_ALIASES = {
+    "LunarLanderContinuous-v2": "LunarLanderContinuous-v3",
 }
 
 
@@ -86,6 +95,8 @@ def make(env_id: str, seed: int = 0, prefer_builtin: bool = False):
         try:
             return _GymnasiumAdapter(env_id, seed=seed)
         except Exception:
+            if env_id in _VERSION_ALIASES:
+                return _GymnasiumAdapter(_VERSION_ALIASES[env_id], seed=seed)
             if env_id in _BUILTIN:
                 return _BUILTIN[env_id](seed=seed)
             raise

@@ -152,12 +152,17 @@ class OnDeviceDDPG:
                 carry.env_state, action, jax.random.split(k_env, E)
             )
             # Packed transition rows [E, D] in types.pack_batch_np order.
+            # Discount is 0 where the env truly terminated; time-limit
+            # truncation (done without terminated) keeps bootstrapping.
+            discount = cfg.gamma * (
+                1.0 - jnp.broadcast_to(out.terminated, (E,)).astype(jnp.float32)
+            )
             rows = jnp.concatenate(
                 [
                     carry.obs,
                     action,
                     out.reward[:, None],
-                    jnp.full((E, 1), cfg.gamma, jnp.float32),
+                    discount[:, None],
                     out.boot_obs,
                     jnp.ones((E, 1), jnp.float32),
                 ],

@@ -59,6 +59,93 @@ def test_make_jax_env_unknown():
         make_jax_env("HalfCheetah-v4")
 
 
+def test_jax_mountain_car_matches_gymnasium_dynamics():
+    gymnasium = pytest.importorskip("gymnasium")
+    from distributed_ddpg_tpu.envs.jax_envs import JaxMountainCar, MountainCarState
+
+    genv = gymnasium.make("MountainCarContinuous-v0")
+    gobs, _ = genv.reset(seed=5)
+    jenv = JaxMountainCar()
+    state = MountainCarState(
+        pos=jnp.float32(gobs[0]), vel=jnp.float32(gobs[1]), t=jnp.int32(0)
+    )
+    rng = np.random.default_rng(11)
+    key = jax.random.PRNGKey(2)
+    for i in range(80):
+        a = rng.uniform(-1, 1, 1).astype(np.float32)
+        key, k = jax.random.split(key)
+        out = jenv.step(state, jnp.asarray(a), k)
+        gobs, grew, gterm, gtrunc, _ = genv.step(a)
+        assert not (gterm or gtrunc)
+        np.testing.assert_allclose(np.asarray(out.obs), gobs, atol=1e-5)
+        np.testing.assert_allclose(float(out.reward), grew, atol=1e-5)
+        assert not bool(out.done)
+        state = out.state
+
+
+def test_builtin_mountain_car_matches_gymnasium():
+    gymnasium = pytest.importorskip("gymnasium")
+    from distributed_ddpg_tpu.envs.mountain_car import MountainCarContinuous
+
+    genv = gymnasium.make("MountainCarContinuous-v0")
+    gobs, _ = genv.reset(seed=5)
+    benv = MountainCarContinuous(seed=0)
+    benv.reset(seed=0)
+    benv._pos, benv._vel = float(gobs[0]), float(gobs[1])
+    rng = np.random.default_rng(11)
+    for _ in range(80):
+        a = rng.uniform(-1, 1, 1).astype(np.float32)
+        bobs, brew, bterm, btrunc, _ = benv.step(a)
+        gobs, grew, gterm, gtrunc, _ = genv.step(a)
+        np.testing.assert_allclose(bobs, gobs, atol=1e-6)
+        np.testing.assert_allclose(brew, grew, atol=1e-6)
+        assert (bterm, btrunc) == (gterm, gtrunc)
+
+
+def test_jax_mountain_car_terminates_at_goal():
+    from distributed_ddpg_tpu.envs.jax_envs import JaxMountainCar, MountainCarState
+
+    env = JaxMountainCar()
+    state = MountainCarState(
+        pos=jnp.float32(0.449), vel=jnp.float32(0.05), t=jnp.int32(10)
+    )
+    out = env.step(state, jnp.ones(1), jax.random.PRNGKey(3))
+    assert bool(out.terminated) and bool(out.done)
+    assert float(out.reward) == pytest.approx(100.0 - 0.1)
+    assert int(out.state.t) == 0                       # auto-reset happened
+    assert float(out.boot_obs[0]) >= env.goal_position  # pre-reset next obs
+    assert -0.6 <= float(out.obs[0]) <= -0.4            # fresh start
+
+
+def test_ondevice_stores_zero_discount_on_termination():
+    from distributed_ddpg_tpu.ondevice import OnDeviceDDPG
+
+    cfg = _tiny_config(
+        env_id="MountainCarContinuous-v0", num_actors=8, replay_min_size=4096
+    )
+    trainer = OnDeviceDDPG(cfg, chunk_size=128)
+    # Plant every env just below the goal moving fast: the first step
+    # terminates all of them.
+    carry = trainer.carry
+    env_state = jax.device_get(carry.env_state)
+    env_state = type(env_state)(
+        pos=jnp.full_like(env_state.pos, 0.449),
+        vel=jnp.full_like(env_state.vel, 0.07),
+        t=env_state.t,
+    )
+    trainer.carry = carry._replace(env_state=jax.device_put(env_state))
+    trainer.run_chunk()
+    rows = np.asarray(jax.device_get(trainer.carry.storage))
+    size = int(jax.device_get(trainer.carry.size))
+    obs_dim, act_dim = trainer.obs_dim, trainer.act_dim
+    discount_col = obs_dim + act_dim + 1
+    discounts = rows[:size, discount_col]
+    # The first 8 stored rows are the terminal transitions -> discount 0;
+    # later in-episode rows keep gamma.
+    assert np.all(discounts[:8] == 0.0)
+    assert np.any(discounts[8:] == np.float32(cfg.gamma))
+
+
 def _tiny_config(**kw):
     base = dict(
         env_id="Pendulum-v1",
