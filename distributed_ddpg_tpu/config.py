@@ -79,6 +79,7 @@ class DDPGConfig:
     checkpoint_dir: str = ""
     resume: bool = True              # auto-restore latest checkpoint_dir state
     log_path: str = ""               # JSONL metrics path ("" = stdout only)
+    tb_dir: str = ""                 # TensorBoard summary dir ("" = off)
     profile_dir: str = ""            # jax.profiler trace dir ("" = off)
     inject_fault: str = ""           # fault-injection hook (SURVEY.md §5)
 

@@ -1,10 +1,12 @@
-"""Structured metrics: JSONL + stdout (SURVEY.md §5 'Metrics / logging').
+"""Structured metrics: JSONL + stdout + optional TensorBoard (SURVEY.md §5
+'Metrics / logging').
 
-Replaces the reference's TensorBoard scalar summaries [RECALL] with
-append-only JSONL (one object per event, machine-parseable by the bench
-harness) plus optional human lines. Tracked quantities follow SURVEY.md §5:
-episode return, losses, mean Q, grad norms, buffer fill, actor/learner
-steps/sec, staleness.
+The reference's only observability was TensorBoard scalar summaries
+[RECALL]; here the primary sink is append-only JSONL (one object per event,
+machine-parseable by the bench harness) plus optional human lines, with a
+TensorBoard sink (`tb_dir`) kept for parity — scalars land under
+`<kind>/<field>`. Tracked quantities follow SURVEY.md §5: episode return,
+losses, mean Q, grad norms, buffer fill, actor/learner steps/sec, staleness.
 """
 
 from __future__ import annotations
@@ -12,14 +14,26 @@ from __future__ import annotations
 import json
 import sys
 import time
+import warnings
 from typing import Any, Dict, Optional
 
 
 class MetricsLogger:
-    def __init__(self, path: str = "", echo: bool = True):
+    def __init__(self, path: str = "", echo: bool = True, tb_dir: str = ""):
         self._file = open(path, "a", buffering=1) if path else None
         self._echo = echo
         self._t0 = time.time()
+        self._tb = None
+        if tb_dir:
+            try:
+                # torch (CPU) is a baked-in dependency; its pure-Python event
+                # writer needs no torch tensors for scalars.
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(tb_dir)
+            except Exception as e:  # degrade to JSONL-only, loudly once
+                warnings.warn(f"tb_dir={tb_dir!r} requested but TensorBoard "
+                              f"writer unavailable: {e}")
 
     def log(self, kind: str, step: int, **fields: Any) -> Dict[str, Any]:
         rec = {
@@ -33,11 +47,18 @@ class MetricsLogger:
             self._file.write(line + "\n")
         if self._echo:
             print(line, file=sys.stdout, flush=True)
+        if self._tb is not None:
+            for k, v in rec.items():
+                if k in ("kind", "step") or not isinstance(v, (int, float)):
+                    continue
+                self._tb.add_scalar(f"{kind}/{k}", v, step)
         return rec
 
     def close(self) -> None:
         if self._file:
             self._file.close()
+        if self._tb is not None:
+            self._tb.close()
 
 
 def _jsonable(v):

@@ -65,7 +65,7 @@ def train_native(config: DDPGConfig) -> Dict[str, float]:
         seed=config.seed + 1,
     )
     nstep = NStepAccumulator(config.n_step, config.gamma)
-    log = MetricsLogger(config.log_path)
+    log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
     learn_timer = Timer()
     learn_steps = 0
     metrics: Dict[str, float] = {}
@@ -123,7 +123,7 @@ def train_ondevice(config: DDPGConfig) -> Dict[str, float]:
 
     multihost.initialize()
     trainer = OnDeviceDDPG(config)
-    log = MetricsLogger(config.log_path)
+    log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
 
     # Resume: the checkpoint contract matches the other backends (TrainState
     # + replay contents + env-step offset), via a thin adapter for the
@@ -320,7 +320,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         )
 
     pool.start(learner.actor_params_to_host())
-    log = MetricsLogger(config.log_path)
+    log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
     learn_timer, env_timer = Timer(), Timer()
     last_ckpt = learn_steps
     eval_policy = NumpyPolicy(

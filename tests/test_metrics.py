@@ -1,0 +1,44 @@
+"""MetricsLogger tests (SURVEY.md §5 'Metrics / logging'): JSONL records and
+the TensorBoard parity sink."""
+
+import json
+import os
+
+from distributed_ddpg_tpu.metrics import MetricsLogger, Timer
+
+
+def test_jsonl_records(tmp_path):
+    path = tmp_path / "m.jsonl"
+    log = MetricsLogger(str(path), echo=False)
+    log.log("train", 10, critic_loss=0.5, note="hi")
+    log.log("eval", 20, eval_return=-100.0)
+    log.close()
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r["kind"] for r in recs] == ["train", "eval"]
+    assert recs[0]["critic_loss"] == 0.5
+    assert recs[0]["note"] == "hi"          # non-numeric passes through
+    assert recs[1]["step"] == 20
+
+
+def test_tensorboard_sink(tmp_path):
+    tb_dir = tmp_path / "tb"
+    log = MetricsLogger(echo=False, tb_dir=str(tb_dir))
+    assert log._tb is not None, "torch TB writer should be available here"
+    log.log("train", 1, critic_loss=1.25, episode_return=None)
+    log.close()
+    events = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(tb_dir)
+        for f in fs
+        if "tfevents" in f
+    ]
+    assert events, "no TensorBoard event file written"
+    assert os.path.getsize(events[0]) > 0
+
+
+def test_timer_rates():
+    t = Timer()
+    t.tick(10)
+    assert t.rate() > 0
+    t.reset()
+    assert t.rate() == 0.0
