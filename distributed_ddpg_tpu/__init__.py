@@ -19,8 +19,19 @@ BASELINE.json):
 """
 
 from distributed_ddpg_tpu.config import DDPGConfig
-from distributed_ddpg_tpu.agent import DDPGAgent
 
 __version__ = "0.1.0"
 
 __all__ = ["DDPGConfig", "DDPGAgent", "__version__"]
+
+
+def __getattr__(name):
+    # DDPGAgent pulls in jax; load it lazily (PEP 562) so the N CPU actor
+    # worker processes — which import this package for actors/policy and
+    # envs only — never pay the jax import (time or RSS). See
+    # actors/worker.py: 'Workers never import jax'.
+    if name == "DDPGAgent":
+        from distributed_ddpg_tpu.agent import DDPGAgent
+
+        return DDPGAgent
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

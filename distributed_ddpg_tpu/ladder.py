@@ -1,0 +1,99 @@
+"""The BASELINE.md benchmark ladder as runnable configs (SURVEY.md §7 step 8:
+'Benchmark harness — runs the §6 ladder, emits the metric').
+
+Each rung of BASELINE.json:6-12 maps to a DDPGConfig; `run(rung)` trains it
+and emits the primary metric (learner grad-steps/sec + final return) as one
+JSONL record per rung. `--smoke` shrinks every rung to a budget that
+completes in seconds per rung — topology identical, durations not.
+
+Rungs (BASELINE.md):
+  1 Pendulum-v1          1 actor   uniform       native (CPU baseline)
+  2 LunarLanderContinuous 4 actors  uniform      jax_tpu, 1 core
+  3 BipedalWalker-v3      8 actors  prioritized  jax_tpu, data-parallel mesh
+  4 HalfCheetah-v4       16 actors  uniform      jax_tpu, full local mesh
+  5 Humanoid-v4          64 actors  uniform      jax_tpu, multi-host
+    (rung 5 spans hosts via JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/
+     JAX_PROCESS_ID — parallel/multihost.py; single-host it degrades to the
+     local mesh.)
+
+Usage:
+    python -m distributed_ddpg_tpu.ladder --rungs=1,2 --smoke
+    python -m distributed_ddpg_tpu.ladder --rungs=4          # full rung 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+from distributed_ddpg_tpu.config import DDPGConfig
+
+_COMMON = dict(actor_hidden=(256, 256), critic_hidden=(256, 256))
+
+RUNGS: Dict[int, DDPGConfig] = {
+    1: DDPGConfig(
+        env_id="Pendulum-v1", backend="native", num_actors=1,
+        total_env_steps=50_000, **_COMMON,
+    ),
+    2: DDPGConfig(
+        env_id="LunarLanderContinuous-v2", backend="jax_tpu", num_actors=4,
+        total_env_steps=300_000, **_COMMON,
+    ),
+    3: DDPGConfig(
+        env_id="BipedalWalker-v3", backend="jax_tpu", num_actors=8,
+        prioritized=True, total_env_steps=1_000_000, **_COMMON,
+    ),
+    4: DDPGConfig(
+        env_id="HalfCheetah-v4", backend="jax_tpu", num_actors=16,
+        total_env_steps=1_000_000, **_COMMON,
+    ),
+    5: DDPGConfig(
+        env_id="Humanoid-v4", backend="jax_tpu", num_actors=64,
+        total_env_steps=2_000_000, **_COMMON,
+    ),
+}
+
+_SMOKE = dict(
+    total_env_steps=3_000,
+    replay_min_size=256,
+    eval_every=3_000,
+    eval_episodes=1,
+    replay_capacity=50_000,
+)
+
+
+def run(rung: int, smoke: bool = False) -> Dict[str, float]:
+    from distributed_ddpg_tpu.train import train
+
+    config = RUNGS[rung]
+    if smoke:
+        config = config.replace(**_SMOKE)
+    summary = train(config)
+    record = {
+        "kind": "ladder",
+        "rung": rung,
+        "env_id": config.env_id,
+        "backend": config.backend,
+        "num_actors": config.num_actors,
+        "prioritized": config.prioritized,
+        **{k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()},
+    }
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="distributed_ddpg_tpu.ladder")
+    p.add_argument("--rungs", default="1,2,3,4,5",
+                   help="comma-separated rung numbers from BASELINE.md")
+    p.add_argument("--smoke", action="store_true",
+                   help="seconds-per-rung budgets (topology unchanged)")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+    for rung in (int(r) for r in args.rungs.split(",")):
+        run(rung, smoke=args.smoke)
+
+
+if __name__ == "__main__":
+    main()

@@ -497,6 +497,15 @@ def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None
 
 
 def main(argv=None) -> None:
+    # Honor an explicit JAX_PLATFORMS even where a site customization
+    # programmatically overrides it (same fix as __graft_entry__.py) —
+    # e.g. JAX_PLATFORMS=cpu smoke runs on a TPU-attached host.
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     config = DDPGConfig.from_flags(argv if argv is not None else sys.argv[1:])
     summary = train(config)
     print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})
