@@ -30,10 +30,12 @@ import numpy as np
 
 OBS_DIM, ACT_DIM = 17, 6
 BATCH = 64
-CHUNK = 200          # learner steps per dispatch (lax.scan); measured best
-                     # on v5e-1: 100 -> 23.0k, 200 -> 27.9k, 400 -> 28.3k
-                     # steps/s (diminishing past 200, and longer chunks delay
-                     # actor-experience ingest between dispatches)
+CHUNK = 800          # learner steps per dispatch (lax.scan). With the chunk's
+                     # batches pre-gathered up front and scan unroll=4
+                     # (parallel/learner.py), v5e-1 measures 200 -> 49.7k,
+                     # 800 -> 89.5k, 3200 -> 91.0k steps/s; 800 keeps the
+                     # dispatch under ~9 ms so actor ingest between chunks
+                     # stays timely
 NATIVE_STEPS = 400
 
 

@@ -74,6 +74,48 @@ def test_fused_sampling_chunk():
     assert float(out2.metrics["critic_loss"]) != float(out.metrics["critic_loss"])
 
 
+def test_sample_chunk_matches_manual_steps():
+    """The pre-gathered sample chunk must equal K plain steps over the same
+    indices: replicate the chunk's key-split + randint sampling, gather on
+    the host, feed the single-step path, and compare final params."""
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B, seed=0
+    )
+    K = 3
+    lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, chunk_size=K)
+    ref = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, chunk_size=K)
+    rep = DeviceReplay(
+        capacity=1024, obs_dim=OBS, act_dim=ACT, mesh=lrn.mesh, block_size=256
+    )
+    rep.add_packed(_rows(np.random.default_rng(4), 512))
+
+    # Reproduce the indices sample_chunk_fn will draw from lrn._key.
+    key = jax.device_get(lrn._key)
+    _, sub = jax.random.split(key)
+    idx = np.asarray(jax.random.randint(sub, (K, B), 0, len(rep)))
+
+    out = lrn.run_sample_chunk(rep)
+    assert np.asarray(out.td_errors).shape == (K, B)
+
+    storage = np.asarray(jax.device_get(rep.storage))
+    from distributed_ddpg_tpu.types import unpack_batch
+
+    for k in range(K):
+        ref_out = ref.step(unpack_batch(storage[idx[k]], OBS, ACT)._asdict())
+        np.testing.assert_allclose(
+            np.asarray(ref_out.td_errors),
+            np.asarray(out.td_errors)[k],
+            rtol=1e-5, atol=1e-6,
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        jax.device_get(ref.state.actor_params),
+        jax.device_get(lrn.state.actor_params),
+    )
+
+
 def test_device_replay_checkpoint_roundtrip():
     mesh = make_mesh(-1, 1)
     rep = DeviceReplay(capacity=128, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=32)
