@@ -70,6 +70,11 @@ class DDPGConfig:
     # --- precision ---
     compute_dtype: str = "float32"   # bit-comparability oracle needs f32
     fused_update: bool = False       # pallas fused Adam+Polyak kernel
+    # Pallas megakernel: the whole K-step chunk in one kernel launch, params
+    # VMEM-resident across the chunk (ops/fused_chunk.py). "auto" uses it on
+    # the single-device TPU sample-chunk path whenever the config is in the
+    # kernel's envelope; "on" requires it (error if unsupported); "off" never.
+    fused_chunk: str = "auto"
 
     # --- run control ---
     total_env_steps: int = 100_000
@@ -121,6 +126,11 @@ class DDPGConfig:
             )
         if self.n_step < 1:
             raise ValueError("n_step must be >= 1")
+        if self.fused_chunk not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fused_chunk must be 'auto', 'on', or 'off', got "
+                f"{self.fused_chunk!r}"
+            )
         if not 0 <= self.action_insert_layer <= len(self.critic_hidden):
             raise ValueError(
                 f"action_insert_layer={self.action_insert_layer} out of range "

@@ -1,0 +1,476 @@
+"""Pallas TPU megakernel: K full DDPG learner steps in ONE kernel launch,
+with every parameter tensor resident in VMEM for the whole chunk.
+
+Motivation (SURVEY.md §3.3 hot loop): at DDPG scale (2x256 MLPs, batch 64)
+the XLA scan path is bound by parameter HBM traffic — each step re-reads and
+re-writes params, targets, and both Adam moments (~5 MB/step), roughly half
+the measured 11 us/step on v5e-1. This kernel walks the chunk as a grid of
+K steps whose param/target/moment blocks have CONSTANT index maps, so Mosaic
+fetches them into VMEM once, revisits them across all K grid steps, and
+writes them back to HBM once at the end (the standard accumulator pattern).
+Only the K minibatches stream from HBM (~11 KB/step), double-buffered by the
+pallas pipeline.
+
+The forward/backward math is written out by hand (trace-time Python loops
+over layers; everything stays in VMEM):
+
+  critic loss   L_c = mean(w * (r + disc * Q'(s', mu'(s')) - Q(s,a))^2)
+  actor  loss   L_a = -mean(Q(s, mu(s)))          (DPG; bwd through the
+                                                   critic to the action)
+  Adam (ops/optim.py formulas, bias correction from the carried count)
+  Polyak        t <- tau * p + (1 - tau) * t      (ops/polyak.py)
+
+Semantics match learner.make_learner_step exactly: both gradients are taken
+against the PRE-update params of the step; tests/test_fused_chunk.py pins the
+kernel to the XLA scan path over a whole chunk.
+
+Supported envelope (callers must check `supported(config)`):
+  - non-distributional critic, action_insert_layer == 1, critic_l2 == 0
+  - any MLP depths/widths that fit VMEM (the DDPG/D4PG families all do)
+
+On non-TPU backends the kernel runs in pallas interpret mode: numerics are
+identical, speed is not (the XLA scan path remains the CPU choice).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.ops.optim import B1, B2, EPS
+from distributed_ddpg_tpu.types import TrainState, OptState
+
+# Fixed order in which a params tree (tuple of {"w","b"} dicts) is flattened
+# into the kernel's ref list: w0, b0, w1, b1, ...  Biases ride as (1, F) rows
+# so every ref is rank-2 (TPU VMEM wants >= 2D; (F,) -> (1, F) is layout-free).
+
+
+def _flatten(params) -> list:
+    out = []
+    for layer in params:
+        out.append(layer["w"])
+        out.append(layer["b"].reshape(1, -1))
+    return out
+
+
+def _unflatten(flat: Sequence[Any], like) -> Tuple:
+    layers = []
+    for i, layer in enumerate(like):
+        layers.append(
+            {"w": flat[2 * i], "b": flat[2 * i + 1].reshape(layer["b"].shape)}
+        )
+    return tuple(layers)
+
+
+def state_vmem_bytes(config: DDPGConfig, obs_dim: int, act_dim: int) -> int:
+    """f32 bytes of the kernel's VMEM-resident state: 8 copies of each net's
+    tensors (params, targets, mu, nu for actor+critic). The pipeline holds
+    input AND output blocks for each, so callers should budget ~2x this."""
+
+    def net(dims, extra_in=0):
+        total = 0
+        for i in range(len(dims) - 1):
+            d_in = dims[i] + (extra_in if i == 1 else 0)
+            total += d_in * dims[i + 1] + dims[i + 1]
+        return total
+
+    # obs/act enter the actor/critic input dims; action rides into critic
+    # layer 1 (action_insert_layer == 1 inside the supported envelope).
+    a = net([obs_dim, *config.actor_hidden, act_dim])
+    c = net([obs_dim, *config.critic_hidden, 1], extra_in=act_dim)
+    return 4 * (4 * a + 4 * c)
+
+
+# Conservative VMEM budget for the resident state (of ~16 MB/core): leaves
+# room for the doubled in/out blocks, batch stream buffers, and activations.
+VMEM_STATE_BUDGET = 6 * 1024 * 1024
+
+
+def fits_vmem(config: DDPGConfig, obs_dim: int, act_dim: int) -> bool:
+    return state_vmem_bytes(config, obs_dim, act_dim) <= VMEM_STATE_BUDGET
+
+
+def supported(config: DDPGConfig) -> bool:
+    return (
+        not config.distributional
+        and config.action_insert_layer == 1
+        and config.critic_l2 == 0.0
+        and not config.fused_update
+        # The hand-written backward assumes the action-insert layer (1) is
+        # not the critic's output layer, i.e. at least 2 hidden layers.
+        and len(config.critic_hidden) >= 2
+        and len(config.actor_hidden) >= 1
+    )
+
+
+def _mm(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def _dW(x, dz):
+    # x: [B, in], dz: [B, out] -> [in, out]; contract the batch dim without
+    # materializing a transpose.
+    return jax.lax.dot_general(
+        x, dz, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dx(dz, w):
+    # dz: [B, out], w: [in, out] -> [B, in]; contract out dims.
+    return jax.lax.dot_general(
+        dz, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _sq(tree_leaves) -> Any:
+    return sum(jnp.sum(x * x) for x in tree_leaves)
+
+
+def _make_kernel(n_actor: int, n_critic: int, batch: int, config):
+    """Builds the kernel body. n_actor/n_critic = number of linear layers."""
+    tau = float(config.tau)
+    lr_a = float(config.actor_lr)
+    lr_c = float(config.critic_lr)
+    inv_b = 1.0 / float(batch)
+    na2, nc2 = 2 * n_actor, 2 * n_critic
+
+    def kernel(*refs):
+        it = iter(range(len(refs)))
+
+        def take(n):
+            return [refs[next(it)] for _ in range(n)]
+
+        (count_ref,) = take(1)
+        obs_r, act_r, rew_r, disc_r, nobs_r, wgt_r, scale_r, off_r = take(8)
+        actor_in = take(na2)
+        critic_in = take(nc2)
+        t_actor_in = take(na2)
+        t_critic_in = take(nc2)
+        amu_in, anu_in = take(na2), take(na2)
+        cmu_in, cnu_in = take(nc2), take(nc2)
+        td_out, met_out = take(2)
+        actor_o = take(na2)
+        critic_o = take(nc2)
+        t_actor_o = take(na2)
+        t_critic_o = take(nc2)
+        amu_o, anu_o = take(na2), take(na2)
+        cmu_o, cnu_o = take(nc2), take(nc2)
+
+        k = pl.program_id(0)
+
+        # Step 0: seed the VMEM-resident state blocks from the inputs. They
+        # are revisited (constant index maps) for the rest of the grid, so
+        # every later step reads/writes the output blocks only.
+        @pl.when(k == 0)
+        def _seed():
+            for src, dst in zip(
+                actor_in + critic_in + t_actor_in + t_critic_in
+                + amu_in + anu_in + cmu_in + cnu_in,
+                actor_o + critic_o + t_actor_o + t_critic_o
+                + amu_o + anu_o + cmu_o + cnu_o,
+            ):
+                dst[...] = src[...]
+
+        def W(group, i):
+            return group[2 * i][...]
+
+        def Bv(group, i):
+            return group[2 * i + 1][...]
+
+        obs = obs_r[0]
+        action = act_r[0]
+        rew = rew_r[0]
+        disc = disc_r[0]
+        nobs = nobs_r[0]
+        wgt = wgt_r[0]
+        scale = scale_r[...]
+        offset = off_r[...]
+
+        # ---- forwards ----------------------------------------------------
+        def actor_fwd(group, x):
+            """Returns (u, cache) where cache = (pre-acts h_i, activations)."""
+            acts = [x]
+            for i in range(n_actor - 1):
+                z = _mm(acts[-1], W(group, i)) + Bv(group, i)
+                acts.append(jnp.maximum(z, 0.0))
+            z = _mm(acts[-1], W(group, n_actor - 1)) + Bv(group, n_actor - 1)
+            t = jnp.tanh(z)
+            return t * scale + offset, (acts, t)
+
+        def critic_fwd(group, x, a):
+            """Classic DDPG: action enters at layer 1 (split-weight trick —
+            layer 1's weight rows [0:F) multiply the features, rows [F:F+A)
+            multiply the action; same math as concat([h, a]) @ W)."""
+            acts = [x]
+            z0 = _mm(x, W(group, 0)) + Bv(group, 0)
+            h0 = jnp.maximum(z0, 0.0)
+            acts.append(h0)
+            w1 = W(group, 1)
+            f = h0.shape[-1]
+            z1 = _mm(h0, w1[:f]) + _mm(a, w1[f:]) + Bv(group, 1)
+            h1 = jnp.maximum(z1, 0.0)
+            acts.append(h1)
+            for i in range(2, n_critic - 1):
+                z = _mm(acts[-1], W(group, i)) + Bv(group, i)
+                acts.append(jnp.maximum(z, 0.0))
+            q = _mm(acts[-1], W(group, n_critic - 1)) + Bv(group, n_critic - 1)
+            return q, acts  # q: [B, 1]
+
+        # Target path (no grads).
+        u_t, _ = actor_fwd(t_actor_o, nobs)
+        q_t, _ = critic_fwd(t_critic_o, nobs, u_t)
+
+        y = rew + disc * q_t
+        q, c_acts = critic_fwd(critic_o, obs, action)
+        td = y - q
+
+        # ---- critic backward --------------------------------------------
+        # L_c = mean(w * td^2); dL/dq = -2/B * w * td
+        dq = (-2.0 * inv_b) * wgt * td
+
+        def critic_bwd(group, acts, a, dq_in, wgrads: bool):
+            """Backprop dq through the critic. With wgrads, returns
+            (param grads aligned with group order, d_action); without, only
+            d_action is computed (the actor pass needs no critic dW — skips
+            n_critic batch-contraction matmuls per step)."""
+            grads = [None] * nc2
+            dz = dq_in
+            for i in range(n_critic - 1, 1, -1):
+                if wgrads:
+                    grads[2 * i] = _dW(acts[i], dz)
+                    grads[2 * i + 1] = jnp.sum(dz, axis=0, keepdims=True)
+                dh = _dx(dz, W(group, i))
+                dz = dh * (acts[i] > 0.0)
+            # layer 1 (split weights)
+            w1 = W(group, 1)
+            f = acts[1].shape[-1]
+            da = _dx(dz, w1[f:])
+            if not wgrads:
+                return None, da
+            grads[2] = jnp.concatenate(
+                [_dW(acts[1], dz), _dW(a, dz)], axis=0
+            )
+            grads[3] = jnp.sum(dz, axis=0, keepdims=True)
+            dh0 = _dx(dz, w1[:f])
+            dz0 = dh0 * (acts[1] > 0.0)
+            # layer 0
+            grads[0] = _dW(acts[0], dz0)
+            grads[1] = jnp.sum(dz0, axis=0, keepdims=True)
+            return grads, da
+
+        c_grads, _ = critic_bwd(critic_o, c_acts, action, dq, wgrads=True)
+
+        # ---- actor forward + backward (through the pre-update critic) ----
+        u, (a_acts, t_u) = actor_fwd(actor_o, obs)
+        q_pi, pi_acts = critic_fwd(critic_o, obs, u)
+        # dL_a/dq = -1/B
+        dq_pi = jnp.full_like(q_pi, -inv_b)
+        _, da = critic_bwd(critic_o, pi_acts, u, dq_pi, wgrads=False)
+
+        def actor_bwd(group, acts, t_out, da_in):
+            grads = [None] * na2
+            dz = da_in * scale * (1.0 - t_out * t_out)
+            grads[2 * (n_actor - 1)] = _dW(acts[n_actor - 1], dz)
+            grads[2 * (n_actor - 1) + 1] = jnp.sum(dz, axis=0, keepdims=True)
+            for i in range(n_actor - 2, -1, -1):
+                dh = _dx(dz, W(group, i + 1))
+                dz = dh * (acts[i + 1] > 0.0)
+                grads[2 * i] = _dW(acts[i], dz)
+                grads[2 * i + 1] = jnp.sum(dz, axis=0, keepdims=True)
+            return grads
+
+        a_grads = actor_bwd(actor_o, a_acts, t_u, da)
+
+        # ---- Adam + Polyak, all in VMEM ---------------------------------
+        # count_ref = [actor_count0, critic_count0]: each net's bias
+        # correction follows ITS OWN carried Adam count (they only coincide
+        # when the TrainState has always stepped both nets together).
+        def apply(n2, p_o, t_o, mu_o, nu_o, grads, lr, count0):
+            t_step = (count0 + k + 1).astype(jnp.float32)
+            bc1 = 1.0 - jnp.power(jnp.float32(B1), t_step)
+            bc2 = 1.0 - jnp.power(jnp.float32(B2), t_step)
+            for j in range(n2):
+                g = grads[j]
+                m = B1 * mu_o[j][...] + (1.0 - B1) * g
+                v = B2 * nu_o[j][...] + (1.0 - B2) * (g * g)
+                p = p_o[j][...] - lr * (m / bc1) / (jnp.sqrt(v / bc2) + EPS)
+                mu_o[j][...] = m
+                nu_o[j][...] = v
+                p_o[j][...] = p
+                t_o[j][...] = tau * p + (1.0 - tau) * t_o[j][...]
+
+        apply(nc2, critic_o, t_critic_o, cmu_o, cnu_o, c_grads, lr_c,
+              count_ref[1])
+        apply(na2, actor_o, t_actor_o, amu_o, anu_o, a_grads, lr_a,
+              count_ref[0])
+
+        # ---- outputs -----------------------------------------------------
+        td_out[0] = td
+        closs = jnp.sum(wgt * td * td) * inv_b
+        aloss = -jnp.sum(q_pi) * inv_b
+        # Order must match learner.METRIC_KEYS; the wrapper sizes the metric
+        # block from len(METRIC_KEYS) and asserts this stack agrees.
+        step_metrics = [
+            closs,
+            aloss,
+            -aloss,
+            jnp.sum(jnp.abs(td)) * inv_b,
+            jnp.sqrt(_sq(c_grads)),
+            jnp.sqrt(_sq(a_grads)),
+        ]
+        assert len(step_metrics) == met_out.shape[-1]
+        met_out[0, :] = jnp.stack(step_metrics)
+
+    return kernel
+
+
+def runs_native() -> bool:
+    """True when the current backend compiles pallas TPU kernels natively;
+    elsewhere the kernel runs in interpret mode (correct, far slower)."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def make_fused_chunk_fn(
+    config: DDPGConfig,
+    obs_dim: int,
+    act_dim: int,
+    action_scale,
+    action_offset=0.0,
+    chunk_size: int = 8,
+    interpret: bool | None = None,
+):
+    """Returns jittable (state, batches[K, B, width]) ->
+    (new_state, td[K, B], metrics{6 scalars}) running the whole chunk in one
+    pallas launch. `batches` is the packed wire format (types.pack_batch_np
+    layout); callers gather it from replay storage however they like."""
+    if not supported(config):
+        raise ValueError(
+            "fused chunk kernel supports the classic DDPG envelope only: "
+            "distributional=False, action_insert_layer=1, critic_l2=0, "
+            "fused_update=False, >=2 critic hidden layers, >=1 actor hidden"
+        )
+    if not fits_vmem(config, obs_dim, act_dim):
+        raise ValueError(
+            f"fused chunk kernel: VMEM-resident state would be "
+            f"{state_vmem_bytes(config, obs_dim, act_dim)} bytes "
+            f"(budget {VMEM_STATE_BUDGET}); use the XLA scan path "
+            f"(fused_chunk='off') for nets this large"
+        )
+    K = int(chunk_size)
+    B = int(config.batch_size)
+    o, a = int(obs_dim), int(act_dim)
+    interp = (not runs_native()) if interpret is None else interpret
+    scale = jnp.broadcast_to(
+        jnp.asarray(action_scale, jnp.float32), (1, a)
+    )
+    offset = jnp.broadcast_to(
+        jnp.asarray(action_offset, jnp.float32), (1, a)
+    )
+
+    from distributed_ddpg_tpu.learner import METRIC_KEYS
+
+    def run(state: TrainState, batches):
+        n_actor = len(state.actor_params)
+        n_critic = len(state.critic_params)
+        na2, nc2 = 2 * n_actor, 2 * n_critic
+
+        obs = batches[..., :o]
+        act = batches[..., o : o + a]
+        rew = batches[..., o + a : o + a + 1]
+        disc = batches[..., o + a + 1 : o + a + 2]
+        nobs = batches[..., o + a + 2 : 2 * o + a + 2]
+        wgt = batches[..., 2 * o + a + 2 : 2 * o + a + 3]
+
+        state_flat = (
+            _flatten(state.actor_params)
+            + _flatten(state.critic_params)
+            + _flatten(state.target_actor_params)
+            + _flatten(state.target_critic_params)
+            + _flatten(state.actor_opt.mu)
+            + _flatten(state.actor_opt.nu)
+            + _flatten(state.critic_opt.mu)
+            + _flatten(state.critic_opt.nu)
+        )
+
+        def stream_spec(d):
+            return pl.BlockSpec(
+                (1, B, d), lambda k: (k, 0, 0), memory_space=pltpu.VMEM
+            )
+
+        def pinned_spec(arr):
+            nd = len(arr.shape)
+            return pl.BlockSpec(
+                arr.shape, lambda k: (0,) * nd, memory_space=pltpu.VMEM
+            )
+
+        in_specs = (
+            [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [stream_spec(o), stream_spec(a), stream_spec(1), stream_spec(1),
+               stream_spec(o), stream_spec(1)]
+            + [pinned_spec(scale), pinned_spec(offset)]
+            + [pinned_spec(x) for x in state_flat]
+        )
+        out_specs = (
+            [
+                pl.BlockSpec(
+                    (1, B, 1), lambda k: (k, 0, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (1, len(METRIC_KEYS)), lambda k: (k, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ]
+            + [pinned_spec(x) for x in state_flat]
+        )
+        out_shape = (
+            [
+                jax.ShapeDtypeStruct((K, B, 1), jnp.float32),
+                jax.ShapeDtypeStruct((K, len(METRIC_KEYS)), jnp.float32),
+            ]
+            + [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in state_flat]
+        )
+
+        kernel = _make_kernel(n_actor, n_critic, B, config)
+        count0 = jnp.stack(
+            [state.actor_opt.count, state.critic_opt.count]
+        ).astype(jnp.int32)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(K,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interp,
+        )(count0, obs, act, rew, disc, nobs, wgt, scale, offset, *state_flat)
+
+        td = outs[0][..., 0]
+        met = jnp.mean(outs[1], axis=0)
+        flat = list(outs[2:])
+        i = 0
+        actor_p = _unflatten(flat[i : i + na2], state.actor_params); i += na2
+        critic_p = _unflatten(flat[i : i + nc2], state.critic_params); i += nc2
+        t_actor = _unflatten(flat[i : i + na2], state.actor_params); i += na2
+        t_critic = _unflatten(flat[i : i + nc2], state.critic_params); i += nc2
+        amu = _unflatten(flat[i : i + na2], state.actor_params); i += na2
+        anu = _unflatten(flat[i : i + na2], state.actor_params); i += na2
+        cmu = _unflatten(flat[i : i + nc2], state.critic_params); i += nc2
+        cnu = _unflatten(flat[i : i + nc2], state.critic_params); i += nc2
+
+        new_state = TrainState(
+            actor_params=actor_p,
+            critic_params=critic_p,
+            target_actor_params=t_actor,
+            target_critic_params=t_critic,
+            actor_opt=OptState(mu=amu, nu=anu, count=state.actor_opt.count + K),
+            critic_opt=OptState(mu=cmu, nu=cnu, count=state.critic_opt.count + K),
+            step=state.step + K,
+        )
+        metrics = {k_: met[j] for j, k_ in enumerate(METRIC_KEYS)}
+        return new_state, td, metrics
+
+    return run

@@ -172,21 +172,65 @@ class ShardedLearner:
         # chunk (replay/device.py). PRNG key lives on device too.
         batch_size = config.batch_size
 
-        def sample_chunk_fn(s: TrainState, key, storage, size):
-            # Sample ALL of the chunk's minibatch indices up front and gather
-            # them in ONE [K*B]-row gather. Storage is immutable for the whole
-            # dispatch (ingest lands between chunks), so the distribution is
-            # identical to sampling inside the scan body — but one fused
-            # gather replaces K tiny ones: 59.5k -> 89.5k steps/s with
-            # unroll=4 (v5e-1, chunk=800).
+        # Sample ALL of the chunk's minibatch indices up front and gather
+        # them in ONE [K*B]-row gather. Storage is immutable for the whole
+        # dispatch (ingest lands between chunks), so the distribution is
+        # identical to sampling inside the scan body — but one fused gather
+        # replaces K tiny ones: 59.5k -> 89.5k steps/s with unroll=4
+        # (v5e-1, chunk=800). Shared by the scan and megakernel paths so
+        # their index streams stay bit-identical (parity tests rely on it).
+        def draw_chunk(key, storage, size):
             key, sub = jax.random.split(key)
             idx = jax.random.randint(
                 sub, (self.chunk_size, batch_size), 0, jnp.maximum(size, 1)
             )
+            return key, storage[idx]
+
+        def sample_chunk_fn(s: TrainState, key, storage, size):
+            key, packed = draw_chunk(key, storage, size)
             packed = jax.lax.with_sharding_constraint(
-                storage[idx], NamedSharding(self.mesh, P(None, "data", None))
+                packed, NamedSharding(self.mesh, P(None, "data", None))
             )
             return scan_steps(s, unpack_batch(packed, obs_dim, act_dim)), key
+
+        # Pallas megakernel path (ops/fused_chunk.py): the whole chunk in one
+        # kernel, params VMEM-resident. Single-device only — on a >1-device
+        # mesh the XLA scan path's sharding + collectives stay in charge.
+        from distributed_ddpg_tpu.ops import fused_chunk as fused_chunk_lib
+
+        # "auto" additionally requires a real TPU (elsewhere the kernel would
+        # run in pallas interpret mode — correct but far slower than the XLA
+        # scan; "on" forces it anywhere, tests use this) and mode="auto":
+        # mode="explicit" exists to make the shard_map path observable, so it
+        # must never be silently replaced by the megakernel.
+        self.fused_chunk_active = (
+            config.fused_chunk != "off"
+            and self.mode == "auto"
+            and self.mesh.size == 1
+            and fused_chunk_lib.supported(config)
+            and fused_chunk_lib.fits_vmem(config, obs_dim, act_dim)
+            and (config.fused_chunk == "on" or fused_chunk_lib.runs_native())
+        )
+        if config.fused_chunk == "on" and not self.fused_chunk_active:
+            raise ValueError(
+                "fused_chunk='on' but the config/mesh is outside the kernel "
+                "envelope: needs a single-device mesh, mode='auto', plus "
+                "distributional=False, action_insert_layer=1, critic_l2=0, "
+                "fused_update=False, >=2 critic hidden layers, and nets "
+                "small enough for VMEM (ops/fused_chunk.fits_vmem)"
+            )
+        if self.fused_chunk_active:
+            run_fused = fused_chunk_lib.make_fused_chunk_fn(
+                config, obs_dim, act_dim, action_scale, action_offset,
+                chunk_size=self.chunk_size,
+            )
+
+            def fused_sample_chunk_fn(s: TrainState, key, storage, size):
+                key, packed = draw_chunk(key, storage, size)
+                new_s, tds, ms = run_fused(s, packed)
+                return StepOutput(state=new_s, td_errors=tds, metrics=ms), key
+
+            sample_chunk_fn = fused_sample_chunk_fn
 
         storage_sharding = NamedSharding(self.mesh, P(None, None))
         self._sample_chunk_step = jax.jit(

@@ -1,0 +1,171 @@
+"""Parity: the pallas megakernel chunk (ops/fused_chunk.py) must reproduce
+the XLA scan path (learner.make_learner_step applied K times) on identical
+batches — same params, targets, Adam moments, TD errors, and metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.learner import init_train_state, make_learner_step
+from distributed_ddpg_tpu.ops import fused_chunk
+from distributed_ddpg_tpu.types import pack_batch_np, unpack_batch
+
+OBS, ACT, B, K = 5, 3, 16, 4
+
+
+def _batches(rng, k):
+    return pack_batch_np(
+        {
+            "obs": rng.standard_normal((k, B, OBS)).astype(np.float32),
+            "action": rng.uniform(-1, 1, (k, B, ACT)).astype(np.float32),
+            "reward": rng.standard_normal((k, B)).astype(np.float32),
+            "discount": np.full((k, B), 0.99, np.float32),
+            "next_obs": rng.standard_normal((k, B, OBS)).astype(np.float32),
+            "weight": rng.uniform(0.5, 1.0, (k, B)).astype(np.float32),
+        }
+    )
+
+
+def _assert_tree_close(a, b, rtol=2e-5, atol=1e-6):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        ),
+        a,
+        b,
+    )
+
+
+@pytest.mark.parametrize(
+    "hidden,scale,offset",
+    [
+        ((32, 32), 2.0, 0.0),
+        ((32, 24, 16), 1.5, 0.25),  # deeper nets + asymmetric action box
+    ],
+)
+def test_fused_chunk_matches_scan(hidden, scale, offset):
+    cfg = DDPGConfig(
+        actor_hidden=hidden, critic_hidden=hidden, batch_size=B, seed=3
+    )
+    assert fused_chunk.supported(cfg)
+    state = init_train_state(cfg, OBS, ACT, seed=3)
+    packed = _batches(np.random.default_rng(7), K)
+
+    # Reference: K sequential XLA steps.
+    step = make_learner_step(cfg, scale, action_offset=offset)
+    ref = state
+    ref_tds, ref_metrics = [], []
+    for k in range(K):
+        out = step(ref, unpack_batch(jnp.asarray(packed[k]), OBS, ACT))
+        ref = out.state
+        ref_tds.append(np.asarray(out.td_errors))
+        ref_metrics.append(out.metrics)
+
+    run = fused_chunk.make_fused_chunk_fn(
+        cfg, OBS, ACT, scale, offset, chunk_size=K, interpret=True
+    )
+    new_state, td, metrics = jax.jit(run)(state, jnp.asarray(packed))
+
+    _assert_tree_close(new_state.actor_params, ref.actor_params)
+    _assert_tree_close(new_state.critic_params, ref.critic_params)
+    _assert_tree_close(new_state.target_actor_params, ref.target_actor_params)
+    _assert_tree_close(new_state.target_critic_params, ref.target_critic_params)
+    _assert_tree_close(new_state.actor_opt.mu, ref.actor_opt.mu)
+    _assert_tree_close(new_state.critic_opt.nu, ref.critic_opt.nu)
+    assert int(new_state.actor_opt.count) == K
+    assert int(new_state.step) == K
+
+    np.testing.assert_allclose(
+        np.asarray(td), np.stack(ref_tds), rtol=2e-5, atol=1e-6
+    )
+    for name in metrics:
+        want = float(np.mean([float(m[name]) for m in ref_metrics]))
+        np.testing.assert_allclose(
+            float(metrics[name]), want, rtol=5e-5, atol=1e-6
+        )
+
+
+def test_sharded_learner_fused_path_matches_scan_path():
+    """On a 1-device mesh, fused_chunk='on' must reproduce fused_chunk='off'
+    through the public run_sample_chunk API: both draw the same (K, B) index
+    block from the same key stream, so state and TD errors must agree."""
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.mesh import make_mesh
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B, seed=5
+    )
+    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+    rng = np.random.default_rng(11)
+    rows = pack_batch_np(
+        {
+            "obs": rng.standard_normal((256, OBS)).astype(np.float32),
+            "action": rng.uniform(-1, 1, (256, ACT)).astype(np.float32),
+            "reward": rng.standard_normal(256).astype(np.float32),
+            "discount": np.full(256, 0.99, np.float32),
+            "next_obs": rng.standard_normal((256, OBS)).astype(np.float32),
+            "weight": np.ones(256, np.float32),
+        }
+    )
+
+    results = {}
+    for mode in ("on", "off"):
+        lrn = ShardedLearner(
+            cfg.replace(fused_chunk=mode), OBS, ACT,
+            action_scale=1.0, mesh=mesh, chunk_size=K,
+        )
+        assert lrn.fused_chunk_active == (mode == "on")
+        rep = DeviceReplay(
+            capacity=256, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=256
+        )
+        rep.add_packed(rows)
+        out = lrn.run_sample_chunk(rep)
+        results[mode] = (
+            jax.device_get(lrn.state),
+            np.asarray(out.td_errors),
+            {k_: float(v) for k_, v in jax.device_get(out.metrics).items()},
+        )
+
+    _assert_tree_close(results["on"][0].actor_params, results["off"][0].actor_params)
+    _assert_tree_close(results["on"][0].critic_opt.mu, results["off"][0].critic_opt.mu)
+    np.testing.assert_allclose(results["on"][1], results["off"][1], rtol=2e-5, atol=1e-6)
+    for k_ in results["on"][2]:
+        np.testing.assert_allclose(
+            results["on"][2][k_], results["off"][2][k_], rtol=5e-5, atol=1e-6
+        )
+
+
+def test_fused_chunk_on_requires_envelope():
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError):
+        ShardedLearner(
+            DDPGConfig(distributional=True, fused_chunk="on"),
+            OBS, ACT, action_scale=1.0,
+            mesh=make_mesh(1, 1, devices=jax.devices()[:1]),
+        )
+
+
+def test_supported_gates():
+    assert not fused_chunk.supported(DDPGConfig(distributional=True))
+    assert not fused_chunk.supported(DDPGConfig(critic_l2=1e-4))
+    assert not fused_chunk.supported(DDPGConfig(action_insert_layer=0))
+    assert not fused_chunk.supported(DDPGConfig(critic_hidden=(32,)))
+    with pytest.raises(ValueError):
+        fused_chunk.make_fused_chunk_fn(
+            DDPGConfig(distributional=True), OBS, ACT, 1.0
+        )
+    # VMEM budget gate: huge nets fall back to the XLA scan path.
+    big = DDPGConfig(actor_hidden=(1024, 1024), critic_hidden=(1024, 1024))
+    assert fused_chunk.supported(big)
+    assert not fused_chunk.fits_vmem(big, OBS, ACT)
+    with pytest.raises(ValueError, match="VMEM"):
+        fused_chunk.make_fused_chunk_fn(big, OBS, ACT, 1.0)
+    assert fused_chunk.fits_vmem(DDPGConfig(), 17, 6)  # bench scale fits
+    # Config typo guard: only auto/on/off are accepted.
+    with pytest.raises(ValueError, match="fused_chunk"):
+        DDPGConfig(fused_chunk="Off")
