@@ -126,6 +126,16 @@ class DDPGConfig:
             )
         if self.n_step < 1:
             raise ValueError("n_step must be >= 1")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.compute_dtype!r}"
+            )
+        if self.compute_dtype == "bfloat16" and self.backend == "native":
+            raise ValueError(
+                "compute_dtype='bfloat16' requires a JAX backend: the "
+                "native numpy learner is the f32 bit-comparability oracle"
+            )
         if self.fused_chunk not in ("auto", "on", "off"):
             raise ValueError(
                 f"fused_chunk must be 'auto', 'on', or 'off', got "

@@ -105,6 +105,10 @@ def make_learner_step(
     ail = config.action_insert_layer
     scale = jnp.asarray(action_scale, jnp.float32)
     offset = jnp.asarray(action_offset, jnp.float32)
+    # Mixed precision: bf16 matmuls (MXU native rate) with f32 accumulation
+    # and f32 master params/opt state. Default f32 keeps the native-backend
+    # bit-comparability oracle exact (BASELINE.json:5).
+    mm = jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
     support = (
         losses.categorical_support(config.v_min, config.v_max, config.num_atoms)
         if config.distributional
@@ -124,6 +128,7 @@ def make_learner_step(
                     support,
                     ail,
                     offset,
+                    mm,
                 )
         else:
             def critic_loss_fn(cp):
@@ -136,6 +141,7 @@ def make_learner_step(
                     ail,
                     config.critic_l2,
                     offset,
+                    mm,
                 )
 
         (closs, td), cgrads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
@@ -147,11 +153,13 @@ def make_learner_step(
         if config.distributional:
             def actor_loss_fn(ap):
                 return losses.distributional_actor_loss(
-                    ap, state.critic_params, batch, scale, support, ail, offset
+                    ap, state.critic_params, batch, scale, support, ail, offset, mm
                 )
         else:
             def actor_loss_fn(ap):
-                return losses.actor_loss(ap, state.critic_params, batch, scale, ail, offset)
+                return losses.actor_loss(
+                    ap, state.critic_params, batch, scale, ail, offset, mm
+                )
 
         aloss, agrads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
         agrads = _maybe_psum_mean(agrads, axis_name)

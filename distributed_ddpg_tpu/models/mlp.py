@@ -59,13 +59,30 @@ def actor_init(key, obs_dim: int, act_dim: int, hidden: Sequence[int], dtype=jnp
     return mlp_init(key, [obs_dim, *hidden, act_dim], dtype)
 
 
-def actor_apply(params: Params, obs, action_scale, action_offset=0.0) -> Any:
+def _dense(x, layer, mm_dtype):
+    """x @ w + b. With mm_dtype (mixed precision): inputs/weights cast to
+    the matmul dtype (bf16 -> MXU native rate), accumulation and bias stay
+    f32 (`preferred_element_type`), so activations remain f32 throughout —
+    the standard TPU mixed-precision recipe. Master params are always f32."""
+    if mm_dtype is None:
+        return x @ layer["w"] + layer["b"]
+    return (
+        jnp.dot(
+            x.astype(mm_dtype),
+            layer["w"].astype(mm_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        + layer["b"]
+    )
+
+
+def actor_apply(params: Params, obs, action_scale, action_offset=0.0, mm_dtype=None) -> Any:
     """mu(s): relu hiddens, tanh output mapped onto the action box
     [offset - scale, offset + scale] (offset != 0 for asymmetric spaces)."""
     x = obs
     for layer in params[:-1]:
-        x = jax.nn.relu(x @ layer["w"] + layer["b"])
-    x = x @ params[-1]["w"] + params[-1]["b"]
+        x = jax.nn.relu(_dense(x, layer, mm_dtype))
+    x = _dense(x, params[-1], mm_dtype)
     return jnp.tanh(x) * action_scale + action_offset
 
 
@@ -97,14 +114,16 @@ def critic_init(
     return tuple(layers)
 
 
-def critic_apply(params: Params, obs, action, action_insert_layer: int = 1) -> Any:
+def critic_apply(
+    params: Params, obs, action, action_insert_layer: int = 1, mm_dtype=None
+) -> Any:
     """Q(s, a) -> f32[B] (or f32[B, num_atoms] logits when distributional)."""
     x = obs
     n = len(params)
     for i, layer in enumerate(params):
         if i == action_insert_layer:
             x = jnp.concatenate([x, action], axis=-1)
-        x = x @ layer["w"] + layer["b"]
+        x = _dense(x, layer, mm_dtype)
         if i < n - 1:
             x = jax.nn.relu(x)
     if x.shape[-1] == 1:

@@ -101,6 +101,7 @@ def supported(config: DDPGConfig) -> bool:
         and config.action_insert_layer == 1
         and config.critic_l2 == 0.0
         and not config.fused_update
+        and config.compute_dtype == "float32"  # kernel matmuls are f32
         # The hand-written backward assumes the action-insert layer (1) is
         # not the critic's output layer, i.e. at least 2 hidden layers.
         and len(config.critic_hidden) >= 2

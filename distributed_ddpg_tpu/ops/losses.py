@@ -35,14 +35,17 @@ def critic_loss(
     action_insert_layer: int = 1,
     l2: float = 0.0,
     action_offset=0.0,
+    mm_dtype=None,
 ):
     """Weighted MSE TD loss. Returns (loss, td_errors[B])."""
-    next_action = actor_apply(target_actor_params, batch.next_obs, action_scale, action_offset)
+    next_action = actor_apply(
+        target_actor_params, batch.next_obs, action_scale, action_offset, mm_dtype
+    )
     next_q = critic_apply(
-        target_critic_params, batch.next_obs, next_action, action_insert_layer
+        target_critic_params, batch.next_obs, next_action, action_insert_layer, mm_dtype
     )
     y = jax.lax.stop_gradient(td_targets(batch, next_q))
-    q = critic_apply(critic_params, batch.obs, batch.action, action_insert_layer)
+    q = critic_apply(critic_params, batch.obs, batch.action, action_insert_layer, mm_dtype)
     td = y - q
     loss = jnp.mean(batch.weight * jnp.square(td))
     if l2 > 0.0:
@@ -59,10 +62,11 @@ def actor_loss(
     action_scale,
     action_insert_layer: int = 1,
     action_offset=0.0,
+    mm_dtype=None,
 ):
     """DPG loss: ascend Q(s, mu(s))."""
-    action = actor_apply(actor_params, batch.obs, action_scale, action_offset)
-    q = critic_apply(critic_params, batch.obs, action, action_insert_layer)
+    action = actor_apply(actor_params, batch.obs, action_scale, action_offset, mm_dtype)
+    q = critic_apply(critic_params, batch.obs, action, action_insert_layer, mm_dtype)
     return -jnp.mean(q)
 
 
@@ -113,20 +117,25 @@ def distributional_critic_loss(
     support,
     action_insert_layer: int = 1,
     action_offset=0.0,
+    mm_dtype=None,
 ):
     """Categorical TD loss (cross-entropy vs projected target distribution).
 
     Returns (loss, td_error_proxy[B]) where the proxy is |E[Z] - E[Z_target]|
     (used for PER priorities, as in D4PG follow-ups)."""
-    next_action = actor_apply(target_actor_params, batch.next_obs, action_scale, action_offset)
+    next_action = actor_apply(
+        target_actor_params, batch.next_obs, action_scale, action_offset, mm_dtype
+    )
     target_logits = critic_apply(
-        target_critic_params, batch.next_obs, next_action, action_insert_layer
+        target_critic_params, batch.next_obs, next_action, action_insert_layer, mm_dtype
     )
     target_probs = jax.nn.softmax(target_logits, axis=-1)
     proj = jax.lax.stop_gradient(
         categorical_projection(support, target_probs, batch.reward, batch.discount)
     )
-    logits = critic_apply(critic_params, batch.obs, batch.action, action_insert_layer)
+    logits = critic_apply(
+        critic_params, batch.obs, batch.action, action_insert_layer, mm_dtype
+    )
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     ce = -jnp.sum(proj * logprobs, axis=-1)
     loss = jnp.mean(batch.weight * ce)
@@ -143,8 +152,9 @@ def distributional_actor_loss(
     support,
     action_insert_layer: int = 1,
     action_offset=0.0,
+    mm_dtype=None,
 ):
-    action = actor_apply(actor_params, batch.obs, action_scale, action_offset)
-    logits = critic_apply(critic_params, batch.obs, action, action_insert_layer)
+    action = actor_apply(actor_params, batch.obs, action_scale, action_offset, mm_dtype)
+    logits = critic_apply(critic_params, batch.obs, action, action_insert_layer, mm_dtype)
     q = jnp.sum(jax.nn.softmax(logits, axis=-1) * support[None, :], axis=-1)
     return -jnp.mean(q)

@@ -216,8 +216,9 @@ class ShardedLearner:
                 "fused_chunk='on' but the config/mesh is outside the kernel "
                 "envelope: needs a single-device mesh, mode='auto', plus "
                 "distributional=False, action_insert_layer=1, critic_l2=0, "
-                "fused_update=False, >=2 critic hidden layers, and nets "
-                "small enough for VMEM (ops/fused_chunk.fits_vmem)"
+                "fused_update=False, compute_dtype='float32', >=2 critic "
+                "hidden layers, and nets small enough for VMEM "
+                "(ops/fused_chunk.fits_vmem)"
             )
         if self.fused_chunk_active:
             run_fused = fused_chunk_lib.make_fused_chunk_fn(
