@@ -52,6 +52,36 @@ class ActorPool:
         self._shared = self._ctx.Array("f", layout_size(self.layout), lock=False)
         self._version = self._ctx.Value("l", 0)
         self._queue = self._ctx.Queue(maxsize=4 * self.num_actors)
+        # Transport resolution (config.transport): per-worker C++ SPSC rings
+        # in anonymous shared memory when available; mp.Queue otherwise. Row
+        # layout: [obs, action, reward, discount, next_obs, version] — the
+        # trailing version column carries the param-staleness tag that the
+        # queue path sends alongside each batch.
+        from distributed_ddpg_tpu import native
+
+        if config.transport == "shm" and not native.available():
+            raise RuntimeError(
+                "transport='shm' but the native replay core is unavailable "
+                "(no C++ toolchain?); use transport='queue'"
+            )
+        self.transport = (
+            "shm"
+            if config.transport in ("auto", "shm") and native.available()
+            else "queue"
+        )
+        self.row_width = 2 * spec.obs_dim + spec.act_dim + 3
+        self._rings = []
+        self._ring_bufs = []
+        if self.transport == "shm":
+            nbytes = native.ShmRing.nbytes(config.shm_ring_rows, self.row_width)
+            for _ in range(self.num_actors):
+                buf = self._ctx.Array("B", nbytes, lock=False)
+                self._ring_bufs.append(buf)
+                self._rings.append(
+                    native.ShmRing(
+                        buf, config.shm_ring_rows, self.row_width, init=True
+                    )
+                )
         self._episodes = self._ctx.Queue(maxsize=16 * self.num_actors)
         self._heartbeat = self._ctx.Array("d", self.num_actors, lock=False)
         self._stop = self._ctx.Value("b", 0)
@@ -88,6 +118,10 @@ class ActorPool:
                 shared_params=self._shared,
                 param_version=self._version,
                 transition_queue=self._queue,
+                ring_buf=(
+                    self._ring_bufs[worker_id] if self.transport == "shm" else None
+                ),
+                ring_rows=self.config.shm_ring_rows,
                 heartbeat=self._heartbeat,
                 stop_flag=self._stop,
                 ou_theta=self.config.ou_theta,
@@ -157,10 +191,52 @@ class ActorPool:
 
     # --- experience (workers -> replay) ---
 
-    def drain_into(self, replay, max_batches: int = 1000) -> int:
-        """Move queued transition batches into replay; returns transitions moved."""
+    def _rows_to_batch(self, rows: np.ndarray) -> Dict[str, np.ndarray]:
+        o, a = self.spec.obs_dim, self.spec.act_dim
+        return {
+            "obs": rows[:, :o],
+            "action": rows[:, o : o + a],
+            "reward": rows[:, o + a],
+            "discount": rows[:, o + a + 1],
+            "next_obs": rows[:, o + a + 2 : 2 * o + a + 2],
+        }
+
+    def _pop_ring_batches(self, max_rows: Optional[int]) -> List[Dict[str, np.ndarray]]:
+        out = []
+        remaining = self.config.shm_ring_rows * self.num_actors if max_rows is None else int(max_rows)
+        for wid, ring in enumerate(self._rings):
+            if remaining <= 0:
+                break
+            rows = ring.pop(remaining)
+            if rows.shape[0]:
+                # The version column tags which param snapshot produced each
+                # row; rows are in production order, so the last row carries
+                # the freshest tag.
+                self._note_version(wid, int(rows[-1, -1]))
+                out.append(self._rows_to_batch(rows))
+                self._steps_received += rows.shape[0]
+                remaining -= rows.shape[0]
+        return out
+
+    def drain_into(self, replay, max_batches: int = 1000, max_rows: Optional[int] = None) -> int:
+        """Move pending transitions into replay; returns transitions moved.
+        `max_rows` caps the transitions taken (the ingest rate limiter's
+        budget); overshoot is at most one queue batch on the queue path."""
         moved = 0
+        if self.transport == "shm":
+            for batch in self._pop_ring_batches(max_rows):
+                replay.add_batch(
+                    batch["obs"],
+                    batch["action"],
+                    batch["reward"],
+                    batch["discount"],
+                    batch["next_obs"],
+                )
+                moved += len(batch["reward"])
+            return moved
         for _ in range(max_batches):
+            if max_rows is not None and moved >= max_rows:
+                break
             try:
                 wid, version, batch = self._queue.get_nowait()
             except queue_mod.Empty:
@@ -177,18 +253,26 @@ class ActorPool:
         self._steps_received += moved
         return moved
 
-    def drain_batches(self, max_batches: int = 1000) -> List[Dict[str, np.ndarray]]:
-        """Pop queued transition batches raw (for the device-replay ingest
+    def drain_batches(
+        self, max_batches: int = 1000, max_rows: Optional[int] = None
+    ) -> List[Dict[str, np.ndarray]]:
+        """Pop pending transition batches raw (for the device-replay ingest
         path, which packs them itself); returns a list of field dicts."""
+        if self.transport == "shm":
+            return self._pop_ring_batches(max_rows)
         out = []
+        moved = 0
         for _ in range(max_batches):
+            if max_rows is not None and moved >= max_rows:
+                break
             try:
                 wid, version, batch = self._queue.get_nowait()
             except queue_mod.Empty:
                 break
             self._note_version(wid, version)
             out.append(batch)
-            self._steps_received += len(batch["reward"])
+            moved += len(batch["reward"])
+        self._steps_received += moved
         return out
 
     def episode_stats(self) -> List[tuple]:

@@ -31,9 +31,11 @@ def run_worker(
     action_high,
     shared_params,          # mp.Array('f'), flat actor params
     param_version,          # mp.Value('l')
-    transition_queue,       # mp.Queue
+    transition_queue,       # mp.Queue (fallback transport)
     heartbeat,              # mp.Array('d', num_workers)
     stop_flag,              # mp.Value('b')
+    ring_buf,               # mp.Array('B') backing a native.ShmRing, or None
+    ring_rows: int,
     ou_theta: float,
     ou_sigma: float,
     ou_dt: float,
@@ -60,7 +62,21 @@ def run_worker(
     flat_scratch = np.empty_like(flat_view)
     seen_version = -1
 
+    # shm transport: attach to the pool's ring (the parent already ran
+    # ring_init; the cached .so compiles in the parent so this load is a
+    # dlopen, not a g++ run). The ring and the queue never mix — the pool
+    # drains whichever transport it configured.
+    ring = None
+    if ring_buf is not None:
+        from distributed_ddpg_tpu import native
+
+        obs_dim = layout[0][0][0]  # first layer w is (obs_dim, hidden)
+        ring = native.ShmRing(
+            ring_buf, ring_rows, 2 * obs_dim + act_dim + 3, init=False
+        )
+
     pending: list = []
+    carry = None  # rows the ring had no room for on the last flush
 
     def maybe_refresh():
         """Seqlock read (see ActorPool.broadcast): snapshot to scratch while
@@ -77,6 +93,36 @@ def run_worker(
             seen_version = v
 
     def flush():
+        # seen_version tags which param snapshot produced this experience —
+        # the pool converts it to learner-step staleness (SURVEY.md §5
+        # 'params-staleness per actor').
+        nonlocal carry
+        if ring is not None:
+            if pending:
+                n = len(pending)
+                rows = np.empty((n, ring.width), np.float32)
+                o = pending[0][0].shape[-1]
+                rows[:, :o] = np.stack([p[0] for p in pending])
+                rows[:, o : o + act_dim] = np.stack([p[1] for p in pending])
+                rows[:, o + act_dim] = [p[2] for p in pending]
+                rows[:, o + act_dim + 1] = [p[3] for p in pending]
+                rows[:, o + act_dim + 2 : 2 * o + act_dim + 2] = np.stack(
+                    [p[4] for p in pending]
+                )
+                rows[:, -1] = float(seen_version)
+                pending.clear()
+                carry = rows if carry is None else np.concatenate([carry, rows])
+            # Backpressure mirrors mp.Queue.put: block (stamping the
+            # heartbeat so the monitor doesn't respawn a merely-throttled
+            # worker) until the learner drains the ring. This throttles env
+            # stepping instead of dropping experience.
+            while carry is not None and not stop_flag.value:
+                accepted = ring.push(carry)
+                carry = carry[accepted:] if accepted < carry.shape[0] else None
+                if carry is not None:
+                    heartbeat[worker_id] = time.time()
+                    time.sleep(0.001)
+            return
         if not pending:
             return
         batch = {
@@ -86,9 +132,6 @@ def run_worker(
             "discount": np.asarray([p[3] for p in pending], np.float32),
             "next_obs": np.stack([p[4] for p in pending]),
         }
-        # seen_version tags which param snapshot produced this experience —
-        # the pool converts it to learner-step staleness (SURVEY.md §5
-        # 'params-staleness per actor').
         transition_queue.put((worker_id, seen_version, batch))
         pending.clear()
 

@@ -57,6 +57,14 @@ class DDPGConfig:
 
     # --- distributed topology ---
     num_actors: int = 1
+    # Actor->learner experience transport: "shm" = per-worker C++ SPSC ring
+    # in shared memory (native/replay_core.cpp, zero pickling); "queue" =
+    # mp.Queue; "auto" = shm when the native toolchain is available.
+    transport: str = "auto"
+    # Per-worker ring capacity (rows). Sized to absorb a learner-dispatch
+    # of production smoothing, not to buffer stalls: a full ring BLOCKS its
+    # worker (worker.py flush), mirroring the queue transport's backpressure.
+    shm_ring_rows: int = 4096
     # {"native", "jax_tpu", "jax_ondevice"} (BASELINE.json:5). jax_ondevice
     # runs env physics + replay + learner fused in one XLA program
     # (ondevice.py); num_actors then means on-device vector envs.
@@ -64,6 +72,12 @@ class DDPGConfig:
     data_axis: int = -1              # -1: all devices on data axis
     model_axis: int = 1              # tensor-parallel degree over hidden dims
     train_every: int = 1             # env steps between learner steps (sync mode)
+    # Async ingest rate limiter (the staleness-control knob SURVEY.md §7
+    # 'hard parts (b)' calls for): cap drained env steps at
+    # replay_min_size + ratio * learner_steps. When actors outpace the
+    # learner the rings/queues fill and workers block, throttling the env
+    # stepping itself. 0 = free-running async (the reference's semantics).
+    max_ingest_ratio: float = 0.0
     param_refresh_every: int = 1     # learner steps between actor param refresh
     prefetch_depth: int = 2          # host->HBM double-buffer depth
 
@@ -140,6 +154,13 @@ class DDPGConfig:
             raise ValueError(
                 f"fused_chunk must be 'auto', 'on', or 'off', got "
                 f"{self.fused_chunk!r}"
+            )
+        if self.max_ingest_ratio < 0:
+            raise ValueError("max_ingest_ratio must be >= 0 (0 = unlimited)")
+        if self.transport not in ("auto", "shm", "queue"):
+            raise ValueError(
+                f"transport must be 'auto', 'shm', or 'queue', got "
+                f"{self.transport!r}"
             )
         if not 0 <= self.action_insert_layer <= len(self.critic_hidden):
             raise ValueError(

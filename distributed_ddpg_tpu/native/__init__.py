@@ -66,6 +66,16 @@ def load() -> Optional[ctypes.CDLL]:
         lib.st_set.argtypes = [_F64, ctypes.c_int64, _I64, _F64, ctypes.c_int64]
         lib.st_sample.argtypes = [_F64, ctypes.c_int64, _F64, _I64, ctypes.c_int64]
         lib.st_get.argtypes = [_F64, ctypes.c_int64, _I64, _F64, ctypes.c_int64]
+        _VOID = ctypes.c_void_p
+        _F32 = ctypes.POINTER(ctypes.c_float)
+        _I = ctypes.c_int64
+        lib.ring_init.argtypes = [_VOID]
+        lib.ring_push.argtypes = [_VOID, _I, _I, _F32, _I]
+        lib.ring_push.restype = _I
+        lib.ring_pop.argtypes = [_VOID, _I, _I, _F32, _I]
+        lib.ring_pop.restype = _I
+        lib.ring_size.argtypes = [_VOID]
+        lib.ring_size.restype = _I
         _lib = lib
     except Exception:
         _lib = None
@@ -122,3 +132,66 @@ class NativeSumTree(SumTree):
 def make_sum_tree(capacity: int):
     """NativeSumTree when the toolchain cooperates, numpy SumTree otherwise."""
     return NativeSumTree(capacity) if available() else SumTree(capacity)
+
+
+class ShmRing:
+    """SPSC f32-row ring over a shared-memory buffer (replay_core.cpp's
+    ring_* functions). One producer process, one consumer process; the
+    buffer itself comes from the caller (actors/pool.py uses an anonymous
+    mp.Array so spawn-children inherit it without name management).
+
+    Layout: 128-byte header (two cache-line-separated int64 counters owned
+    by C++) + capacity*width f32 rows."""
+
+    HEADER_BYTES = 128
+
+    def __init__(self, buf, capacity: int, width: int, init: bool = False):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native replay core unavailable")
+        self._lib = lib
+        self.capacity = int(capacity)
+        self.width = int(width)
+        # Keep both the raw buffer and a flat uint8 view alive; the void*
+        # passed to C++ points at the view's base.
+        self._buf = buf
+        self._view = np.frombuffer(buf, dtype=np.uint8)
+        if len(self._view) < self.nbytes(capacity, width):
+            raise ValueError(
+                f"ring buffer too small: {len(self._view)} < "
+                f"{self.nbytes(capacity, width)}"
+            )
+        self._ptr = ctypes.c_void_p(self._view.ctypes.data)
+        if init:
+            lib.ring_init(self._ptr)
+
+    @staticmethod
+    def nbytes(capacity: int, width: int) -> int:
+        return ShmRing.HEADER_BYTES + 4 * capacity * width
+
+    def push(self, rows: np.ndarray) -> int:
+        """Append [n, width] f32 rows; returns rows accepted (ring may be
+        full — caller keeps the rest)."""
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[1] != self.width:
+            raise ValueError(f"expected [n, {self.width}] rows, got {rows.shape}")
+        return int(
+            self._lib.ring_push(
+                self._ptr, self.capacity, self.width,
+                _ptr(rows, ctypes.POINTER(ctypes.c_float)), rows.shape[0],
+            )
+        )
+
+    def pop(self, max_rows: int) -> np.ndarray:
+        """Pop up to max_rows rows; returns an owned [n, width] f32 array."""
+        out = np.empty((int(max_rows), self.width), np.float32)
+        n = int(
+            self._lib.ring_pop(
+                self._ptr, self.capacity, self.width,
+                _ptr(out, ctypes.POINTER(ctypes.c_float)), out.shape[0],
+            )
+        )
+        return out[:n]
+
+    def __len__(self) -> int:
+        return int(self._lib.ring_size(self._ptr))

@@ -17,7 +17,6 @@ from typing import Dict
 
 import numpy as np
 
-from distributed_ddpg_tpu.native import make_sum_tree
 from distributed_ddpg_tpu.replay.uniform import UniformReplay
 
 
@@ -36,6 +35,11 @@ class PrioritizedReplay(UniformReplay):
         self.alpha = alpha
         self.beta = beta
         self.eps = eps
+        # Imported lazily: distributed_ddpg_tpu.native imports
+        # replay.sum_tree, so a module-level import here would close an
+        # import cycle whenever `native` is imported first.
+        from distributed_ddpg_tpu.native import make_sum_tree
+
         self._tree = make_sum_tree(capacity)  # C++ core, numpy fallback
         self._max_priority = 1.0
 

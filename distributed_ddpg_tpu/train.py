@@ -343,6 +343,16 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     replay_lock = threading.Lock()
 
     def drain() -> int:
+        # Ingest rate limiter (config.max_ingest_ratio): when the budget is
+        # exhausted, skip draining — transports fill and workers block,
+        # throttling env stepping until the learner catches up.
+        if config.max_ingest_ratio > 0.0:
+            allowed = (
+                max(config.replay_min_size, config.batch_size)
+                + config.max_ingest_ratio * learn_steps
+            )
+            if env_steps() >= allowed:
+                return 0
         if use_device_replay:
             moved = 0
             batches = pool.drain_batches()

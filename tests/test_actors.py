@@ -49,8 +49,15 @@ def test_numpy_policy_matches_jax_actor():
 
 
 @pytest.mark.slow
-def test_pool_streams_transitions_and_respawns():
-    cfg, spec, state = _setup(num_actors=2, inject_fault="actor:0:200")
+@pytest.mark.parametrize("transport", ["shm", "queue"])
+def test_pool_streams_transitions_and_respawns(transport):
+    from distributed_ddpg_tpu import native
+
+    if transport == "shm" and not native.available():
+        pytest.skip("native toolchain unavailable")
+    cfg, spec, state = _setup(
+        num_actors=2, inject_fault="actor:0:200", transport=transport
+    )
     replay = UniformReplay(cfg.replay_capacity, spec.obs_dim, spec.act_dim)
     import jax
 

@@ -52,3 +52,91 @@ def test_fallback_when_disabled(monkeypatch):
     # Restore the loaded state for other tests.
     monkeypatch.delenv("DDPG_DISABLE_NATIVE")
     importlib.reload(nat)
+
+
+# ---------------------------------------------------------------------------
+# SPSC shared-memory ring (native.ShmRing over replay_core.cpp ring_*)
+# ---------------------------------------------------------------------------
+
+
+def _ring(rows=8, width=4):
+    buf = bytearray(native.ShmRing.nbytes(rows, width))
+    return native.ShmRing(buf, rows, width, init=True)
+
+
+def test_ring_roundtrip_and_wraparound():
+    r = _ring(rows=8, width=4)
+    rng = np.random.default_rng(2)
+    sent = []
+    for chunk in (3, 5, 4, 6, 2):  # 20 rows through an 8-row ring
+        rows = rng.standard_normal((chunk, 4)).astype(np.float32)
+        pushed = 0
+        while pushed < chunk:
+            pushed += r.push(rows[pushed:])
+            got = r.pop(64)
+            if got.shape[0]:
+                sent.append(got)
+        # Drain fully so the next chunk always fits eventually.
+        got = r.pop(64)
+        if got.shape[0]:
+            sent.append(got)
+    out = np.concatenate(sent)
+    assert out.shape == (20, 4)
+    # FIFO order must be preserved across wraps; re-generate the stream.
+    rng = np.random.default_rng(2)
+    expect = np.concatenate(
+        [rng.standard_normal((c, 4)).astype(np.float32) for c in (3, 5, 4, 6, 2)]
+    )
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_ring_full_partial_accept():
+    r = _ring(rows=4, width=2)
+    rows = np.arange(12, dtype=np.float32).reshape(6, 2)
+    assert r.push(rows) == 4          # only capacity rows accepted
+    assert len(r) == 4
+    assert r.push(rows[4:]) == 0      # full
+    got = r.pop(2)
+    np.testing.assert_array_equal(got, rows[:2])
+    assert r.push(rows[4:]) == 2      # space freed
+    np.testing.assert_array_equal(r.pop(64), np.concatenate([rows[2:4], rows[4:]]))
+    assert len(r) == 0
+
+
+def _producer(buf, rows, width, n_rows):
+    from distributed_ddpg_tpu import native
+    import numpy as np
+    import time
+
+    ring = native.ShmRing(buf, rows, width, init=False)
+    data = np.arange(n_rows * width, dtype=np.float32).reshape(n_rows, width)
+    pushed = 0
+    deadline = time.time() + 30
+    while pushed < n_rows and time.time() < deadline:
+        pushed += ring.push(data[pushed:])
+    assert pushed == n_rows
+
+
+def test_ring_cross_process():
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    ROWS, WIDTH, N = 64, 3, 1000
+    buf = ctx.Array("B", native.ShmRing.nbytes(ROWS, WIDTH), lock=False)
+    ring = native.ShmRing(buf, ROWS, WIDTH, init=True)
+    p = ctx.Process(target=_producer, args=(buf, ROWS, WIDTH, N))
+    p.start()
+    got = []
+    import time
+
+    deadline = time.time() + 30
+    total = 0
+    while total < N and time.time() < deadline:
+        rows = ring.pop(ROWS)
+        if rows.shape[0]:
+            got.append(rows)
+            total += rows.shape[0]
+    p.join(timeout=10)
+    out = np.concatenate(got)
+    expect = np.arange(N * WIDTH, dtype=np.float32).reshape(N, WIDTH)
+    np.testing.assert_array_equal(out, expect)
