@@ -8,27 +8,39 @@ data pipeline simulated by a pre-filled replay) — for:
     reference baseline (the reference publishes no numbers, BASELINE.md;
     its learner is CPU TF on the same algorithm/shapes), and
   - jax_tpu: the sharded learner on the attached accelerator(s), fed by the
-    production ChunkPrefetcher (sampling + host->HBM transfer included, so
-    this is the honest end-to-end learner rate, not bare FLOPs).
+    device-resident replay (sampling fused into the scanned chunk), with
+    actor ingest modeled at the 16-actor MuJoCo rate and INCLUDED in the
+    measured loop — the honest end-to-end learner rate, not bare FLOPs.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": <jax_tpu steps/s>, "unit": "grad_steps/s",
-   "vs_baseline": <jax_tpu / native>}
+  {"metric": ..., "value": <jax steps/s>, "unit": "grad_steps/s",
+   "vs_baseline": <jax / native>, "mfu": ..., "scaling": {...}, ...}
 
-Env overrides: BENCH_PLATFORM=cpu forces JAX onto host CPU (smoke-testing);
-BENCH_SECONDS scales measurement length.
+Robustness (the round-1 failure mode, VERDICT.md Missing #1): every
+measurement runs in its OWN subprocess with a hard timeout, so a hung or
+Unavailable accelerator backend can neither crash nor stall the harness.
+The accelerator phase is retried with backoff; on persistent failure the
+harness falls back to a JAX-on-CPU measurement, records "tpu_error", still
+emits the JSON line, and exits 0 as long as the native baseline ran.
+
+Env overrides: JAX_PLATFORMS / BENCH_PLATFORM force the accelerator phase's
+platform (smoke-testing); BENCH_SECONDS scales measurement length;
+BENCH_SCALING=0 skips the virtual-device scaling curve.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 OBS_DIM, ACT_DIM = 17, 6
+HIDDEN = (256, 256)
 BATCH = 64
 CHUNK = 800          # learner steps per dispatch (lax.scan). With the chunk's
                      # batches pre-gathered up front and scan unroll=4
@@ -38,14 +50,51 @@ CHUNK = 800          # learner steps per dispatch (lax.scan). With the chunk's
                      # stays timely
 NATIVE_STEPS = 400
 
+# Peak bf16/f32 matmul throughput per chip, for the MFU estimate. Keyed by
+# substring of jax Device.device_kind (lowercased). Sources: public TPU
+# spec sheets; f32 for generations without bf16-only MXU paths is the same
+# MXU number. CPU has no meaningful peak -> no MFU reported.
+_PEAK_FLOPS = [
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def flops_per_grad_step(obs: int, act: int, hidden, batch: int) -> float:
+    """Analytic matmul FLOPs of one DDPG grad step (models/mlp.py shapes;
+    action inserted at critic layer 1). fwd = 2*B*sum(in*out); one grad
+    step does: critic TD update (target-actor fwd + target-critic fwd +
+    critic fwd + critic bwd ~ 2 fwd) and actor DPG update (actor fwd +
+    critic fwd + bwd through both ~ 2 fwd each) => 4*F_actor + 7*F_critic.
+    Elementwise (Adam/Polyak/activations) excluded — MXU-irrelevant."""
+    h = list(hidden)
+    actor_dims = list(zip([obs] + h, h + [act]))
+    critic_ins = [obs] + [h[0] + act] + h[1:]
+    critic_dims = list(zip(critic_ins, h + [1]))
+    f_a = 2.0 * batch * sum(i * o for i, o in actor_dims)
+    f_c = 2.0 * batch * sum(i * o for i, o in critic_dims)
+    return 4.0 * f_a + 7.0 * f_c
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
 
 def _config():
     from distributed_ddpg_tpu.config import DDPGConfig
 
     return DDPGConfig(
         env_id="HalfCheetah-v4",
-        actor_hidden=(256, 256),
-        critic_hidden=(256, 256),
+        actor_hidden=HIDDEN,
+        critic_hidden=HIDDEN,
         batch_size=BATCH,
         num_actors=16,
         replay_capacity=200_000,
@@ -69,40 +118,56 @@ def _fill_replay(config, n=100_000):
     return replay
 
 
-def bench_native(config, replay) -> float:
-    import jax
+# --------------------------------------------------------------------------
+# Phases. Each runs in its own subprocess (see _run_phase) and prints one
+# JSON line as its LAST stdout line.
+# --------------------------------------------------------------------------
 
+def _assert_platform() -> None:
+    from distributed_ddpg_tpu.platform_util import honor_jax_platforms
+
+    honor_jax_platforms()
+
+
+def phase_native() -> dict:
+    """CPU-native numpy learner — the baseline. Runs under JAX_PLATFORMS=cpu
+    (set by the orchestrator) so accelerator health is irrelevant here."""
+    _assert_platform()
     from distributed_ddpg_tpu.learner import init_train_state
     from distributed_ddpg_tpu.native_backend import NativeLearner
 
-    with jax.default_device(jax.local_devices(backend="cpu")[0]):
-        state = init_train_state(config, OBS_DIM, ACT_DIM, seed=0)
+    config = _config()
+    replay = _fill_replay(config)
+    state = init_train_state(config, OBS_DIM, ACT_DIM, seed=0)
     learner = NativeLearner(config, state, action_scale=1.0)
     for _ in range(20):  # warmup (BLAS thread pools etc.)
         learner.step(replay.sample(BATCH))
     t0 = time.perf_counter()
     for _ in range(NATIVE_STEPS):
         learner.step(replay.sample(BATCH))
-    return NATIVE_STEPS / (time.perf_counter() - t0)
+    rate = NATIVE_STEPS / (time.perf_counter() - t0)
+    return {"native_rate": rate}
 
 
-def bench_jax(config, replay, seconds: float) -> float:
+def _measure_jax(config, replay, seconds: float, mesh=None, chunk=CHUNK) -> dict:
     """Steady-state learner rate on the device-resident replay path
     (replay/device.py): sampling is fused into the scanned chunk, and the
     only h2d traffic is the actor ingest stream, modeled at the 16-actor
     MuJoCo rate (~8k transitions/sec) and INCLUDED in the measured loop."""
+    import jax
+
     from distributed_ddpg_tpu.parallel.learner import ShardedLearner
     from distributed_ddpg_tpu.replay.device import DeviceReplay
     from distributed_ddpg_tpu.types import pack_batch_np
 
     learner = ShardedLearner(
-        config, OBS_DIM, ACT_DIM, action_scale=1.0, chunk_size=CHUNK
+        config, OBS_DIM, ACT_DIM, action_scale=1.0, chunk_size=chunk, mesh=mesh
     )
     device_replay = DeviceReplay(
         config.replay_capacity, OBS_DIM, ACT_DIM, mesh=learner.mesh, block_size=4096
     )
     # Initial fill mirroring the host replay contents (warm buffer).
-    idx = np.arange(100_000)
+    idx = np.arange(len(replay))
     device_replay.add_packed(pack_batch_np(replay.gather(idx)))
 
     rng = np.random.default_rng(1)
@@ -119,7 +184,7 @@ def bench_jax(config, replay, seconds: float) -> float:
     deadline = t0 + seconds
     while time.perf_counter() < deadline:
         out = learner.run_sample_chunk(device_replay)
-        steps += CHUNK
+        steps += chunk
         # Ship actor blocks at the modeled ingest rate.
         due = (time.perf_counter() - t0) * actor_rate
         while ingested + 4096 <= due:
@@ -127,33 +192,199 @@ def bench_jax(config, replay, seconds: float) -> float:
             ingested += 4096
     _ = float(out.metrics["critic_loss"])  # sync on the last chunk
     elapsed = time.perf_counter() - t0
-    return steps / elapsed
+    rate = steps / elapsed
+
+    dev = jax.devices()[0]
+    n_dev = learner.mesh.size
+    result = {
+        "rate": rate,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_devices": n_dev,
+        "per_device_rate": rate / n_dev,
+    }
+    peak = _peak_flops(dev.device_kind)
+    if peak is not None:
+        result["mfu"] = rate * flops_per_grad_step(
+            OBS_DIM, ACT_DIM, HIDDEN, BATCH
+        ) / (peak * n_dev)
+    return result
 
 
-def main() -> None:
-    if os.environ.get("BENCH_PLATFORM"):
-        import jax
+def phase_probe() -> dict:
+    """Cheap accelerator-backend health check: initialize the platform and
+    run one tiny op. Keeps the expensive bench phase off dead backends."""
+    import jax
 
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    _assert_platform()
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    val = float(jnp.ones(8).sum())
+    return {"platform": dev.platform, "device_kind": dev.device_kind,
+            "n_devices": len(jax.devices()), "ok": val == 8.0}
+
+
+def phase_jax() -> dict:
+    """Accelerator (or JAX_PLATFORMS-forced) measurement over the FULL local
+    mesh (config data_axis=-1: all attached devices data-parallel)."""
+    _assert_platform()
     seconds = float(os.environ.get("BENCH_SECONDS", "20"))
-
     config = _config()
     replay = _fill_replay(config)
-    native_rate = bench_native(config, replay)
-    jax_rate = bench_jax(config, replay, seconds)
+    return _measure_jax(config, replay, seconds)
 
-    print(
-        json.dumps(
-            {
-                "metric": "learner_grad_steps_per_sec (HalfCheetah-v4 scale, "
-                "2x256 MLPs, batch 64, replay-fed)",
-                "value": round(jax_rate, 1),
-                "unit": "grad_steps/s",
-                "vs_baseline": round(jax_rate / native_rate, 2),
-                "baseline_native_cpu": round(native_rate, 1),
-            }
+
+def phase_scaling() -> dict:
+    """Data-parallel scaling curve on N virtual CPU devices (the multi-chip
+    stand-in this 1-chip environment allows; VERDICT.md Missing #5). The
+    orchestrator sets xla_force_host_platform_device_count=8. Absolute CPU
+    rates are meaningless — the curve's SHAPE (collective + sharding
+    overhead vs data_axis size) is the signal."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "3"))
+    config = _config().replace(fused_chunk="off")
+    replay = _fill_replay(config, n=40_000)
+    curve = {}
+    for n in (1, 2, 4, 8):
+        if n > len(jax.devices()):
+            break
+        mesh = mesh_lib.make_mesh(data_axis=n, devices=jax.devices()[:n])
+        r = _measure_jax(config, replay, seconds, mesh=mesh, chunk=100)
+        curve[str(n)] = round(r["rate"], 1)
+    return {"scaling_cpu_virtual": curve}
+
+
+_PHASES = {
+    "native": phase_native,
+    "probe": phase_probe,
+    "jax": phase_jax,
+    "scaling": phase_scaling,
+}
+
+
+# --------------------------------------------------------------------------
+# Orchestrator
+# --------------------------------------------------------------------------
+
+def _run_phase(name: str, env_overrides: dict, timeout: float):
+    """Run one phase in a subprocess; return (result_dict, None) or
+    (None, error_string). Subprocess isolation means a wedged accelerator
+    runtime is bounded by `timeout` instead of hanging the harness."""
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in env_overrides.items()})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", name],
+            capture_output=True, text=True, timeout=timeout, env=env,
         )
-    )
+    except subprocess.TimeoutExpired:
+        return None, f"{name}: timeout after {timeout:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return None, f"{name}: rc={proc.returncode}: " + " | ".join(tail[-3:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"{name}: no JSON line in output"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--phase", choices=sorted(_PHASES))
+    args = parser.parse_args()
+
+    if args.phase:
+        print(json.dumps(_PHASES[args.phase]()), flush=True)
+        return 0
+
+    result = {
+        "metric": "learner_grad_steps_per_sec (HalfCheetah-v4 scale, "
+        "2x256 MLPs, batch 64, replay-fed)",
+        "unit": "grad_steps/s",
+    }
+    errors = []
+
+    native, err = _run_phase("native", {"JAX_PLATFORMS": "cpu"}, timeout=600)
+    if native:
+        result["baseline_native_cpu"] = round(native["native_rate"], 1)
+    else:
+        errors.append(err)
+
+    # Accelerator phase: honor an explicit platform override; otherwise let
+    # the default (TPU/axon) platform resolve inside the subprocess. Retry
+    # with backoff — the round-1 failure was a transiently Unavailable
+    # remote backend.
+    accel_env = {}
+    forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("BENCH_PLATFORM")
+    if forced:
+        accel_env["JAX_PLATFORMS"] = forced
+    # Probe the backend cheaply (bounded 180s) before committing to the
+    # expensive bench run; a wedged remote TPU runtime then costs 3 short
+    # probes, not 3 full bench timeouts.
+    accel = None
+    probe = None
+    for attempt in range(3):
+        probe, err = _run_phase("probe", accel_env, timeout=180)
+        if probe and probe.get("ok"):
+            break
+        probe = None
+        errors.append(f"probe attempt {attempt + 1}: {err}")
+        time.sleep(10 * (attempt + 1))
+    if probe:
+        accel, err = _run_phase("jax", accel_env, timeout=900)
+        if not accel:
+            errors.append(err)
+    if accel is None and forced != "cpu":
+        # Accelerator dead: fall back to JAX-on-CPU so the harness still
+        # reports an end-to-end jax-path number, clearly labeled. (forced
+        # may be a site default like JAX_PLATFORMS=axon — that must not
+        # suppress the fallback; only an explicit cpu run makes it moot.)
+        result["tpu_error"] = "; ".join(errors[-3:])
+        accel, err = _run_phase(
+            "jax", {"JAX_PLATFORMS": "cpu", "BENCH_SECONDS": "5"}, timeout=900
+        )
+        if err:
+            errors.append(err)
+
+    if accel:
+        result["value"] = round(accel["rate"], 1)
+        result["platform"] = accel["platform"]
+        result["device_kind"] = accel["device_kind"]
+        result["n_devices"] = accel["n_devices"]
+        result["per_device_rate"] = round(accel["per_device_rate"], 1)
+        if "mfu" in accel:
+            result["mfu"] = round(accel["mfu"], 5)
+        if native:
+            result["vs_baseline"] = round(accel["rate"] / native["native_rate"], 2)
+
+    if os.environ.get("BENCH_SCALING", "1") != "0":
+        scaling, err = _run_phase(
+            "scaling",
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count=8").strip(),
+            },
+            timeout=900,
+        )
+        if scaling:
+            result.update(scaling)
+        else:
+            errors.append(err)
+
+    if errors and "tpu_error" not in result:
+        result["errors"] = errors[-3:]
+    print(json.dumps(result), flush=True)
+    return 0 if native else 1
 
 
 if __name__ == "__main__":

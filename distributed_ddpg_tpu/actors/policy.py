@@ -19,6 +19,19 @@ import numpy as np
 Layout = List[Tuple[Tuple[int, ...], Tuple[int, ...]]]  # [(w_shape, b_shape)]
 
 
+def encode_version(version: int) -> np.float32:
+    """Bit-cast an int32 param-version tag into the transition ring's f32
+    version column. A plain float(version) loses integer exactness past
+    2^24; bit-casting keeps the full int32 range. Safe because every hop
+    (row assignment, concatenate, shm ring memcpy) is a bit-preserving
+    f32 copy — nothing does arithmetic on the column."""
+    return np.int32(version).view(np.float32)
+
+
+def decode_version(tag) -> int:
+    return int(np.float32(tag).view(np.int32))
+
+
 def param_layout(obs_dim: int, act_dim: int, hidden: Sequence[int]) -> Layout:
     dims = [obs_dim, *hidden, act_dim]
     return [((dims[i], dims[i + 1]), (dims[i + 1],)) for i in range(len(dims) - 1)]

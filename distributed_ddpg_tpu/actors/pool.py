@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from distributed_ddpg_tpu.actors.policy import (
+    decode_version,
     flatten_params,
     layout_size,
     param_layout,
@@ -207,12 +208,18 @@ class ActorPool:
         for wid, ring in enumerate(self._rings):
             if remaining <= 0:
                 break
-            rows = ring.pop(remaining)
+            # Cap the request at the ring's current occupancy: pop allocates
+            # the full request up front, so asking for the worst case on
+            # every drain churns tens of MB of empty buffers.
+            avail = len(ring)
+            if not avail:
+                continue
+            rows = ring.pop(min(remaining, avail))
             if rows.shape[0]:
                 # The version column tags which param snapshot produced each
                 # row; rows are in production order, so the last row carries
                 # the freshest tag.
-                self._note_version(wid, int(rows[-1, -1]))
+                self._note_version(wid, decode_version(rows[-1, -1]))
                 out.append(self._rows_to_batch(rows))
                 self._steps_received += rows.shape[0]
                 remaining -= rows.shape[0]

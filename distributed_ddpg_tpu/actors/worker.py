@@ -48,7 +48,7 @@ def run_worker(
     # Workers are CPU-only by construction; make BLAS behave in many procs.
     os.environ.setdefault("OMP_NUM_THREADS", "1")
 
-    from distributed_ddpg_tpu.actors.policy import NumpyPolicy
+    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, encode_version
     from distributed_ddpg_tpu.envs import make
     from distributed_ddpg_tpu.ops.noise import OUNoise
     from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
@@ -109,7 +109,7 @@ def run_worker(
                 rows[:, o + act_dim + 2 : 2 * o + act_dim + 2] = np.stack(
                     [p[4] for p in pending]
                 )
-                rows[:, -1] = float(seen_version)
+                rows[:, -1] = encode_version(seen_version)
                 pending.clear()
                 carry = rows if carry is None else np.concatenate([carry, rows])
             # Backpressure mirrors mp.Queue.put: block (stamping the

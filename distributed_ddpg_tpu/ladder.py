@@ -3,8 +3,9 @@
 
 Each rung of BASELINE.json:6-12 maps to a DDPGConfig; `run(rung)` trains it
 and emits the primary metric (learner grad-steps/sec + final return) as one
-JSONL record per rung. `--smoke` shrinks every rung to a budget that
-completes in seconds per rung — topology identical, durations not.
+JSONL record per rung. `--smoke` shrinks every rung — step budgets AND net
+sizes — so each completes in seconds; topology (actors, backend, mesh,
+PER) is unchanged.
 
 Rungs (BASELINE.md):
   1 Pendulum-v1          1 actor   uniform       native (CPU baseline)
@@ -61,6 +62,15 @@ _SMOKE = dict(
     eval_every=3_000,
     eval_episodes=1,
     replay_capacity=50_000,
+    # Smoke means seconds-per-rung: shrink the nets too, or rung 1's
+    # (256,256) native numpy learner alone blows the budget.
+    actor_hidden=(64, 64),
+    critic_hidden=(64, 64),
+    # Pace ingest so smoke runs exercise a real actor/learner interleaving
+    # instead of the actors blowing through the whole step budget during
+    # first-chunk compile (free-running ratio 0 is meaningless at this
+    # scale: 8 learner steps against 16k env steps).
+    max_ingest_ratio=50.0,
 )
 
 
@@ -85,6 +95,9 @@ def run(rung: int, smoke: bool = False) -> Dict[str, float]:
 
 
 def main(argv=None) -> None:
+    from distributed_ddpg_tpu.platform_util import honor_jax_platforms
+
+    honor_jax_platforms()
     p = argparse.ArgumentParser(prog="distributed_ddpg_tpu.ladder")
     p.add_argument("--rungs", default="1,2,3,4,5",
                    help="comma-separated rung numbers from BASELINE.md")

@@ -191,7 +191,11 @@ class ShmRing:
                 _ptr(out, ctypes.POINTER(ctypes.c_float)), out.shape[0],
             )
         )
-        return out[:n]
+        # out[:n] alone would be a view pinning the full max_rows backing
+        # allocation for as long as the caller holds the batch (callers ask
+        # for the worst case, so that can be tens of MB per drain); copy
+        # when the pop came back short so only n rows stay alive.
+        return out[:n].copy() if n < max_rows else out
 
     def __len__(self) -> int:
         return int(self._lib.ring_size(self._ptr))

@@ -55,8 +55,16 @@ def initialize(
         )
         return jax.process_count() > 1
     except RuntimeError as e:
-        if "already initialized" in str(e):
+        msg = str(e)
+        if "already initialized" in msg:
             return jax.process_count() > 1
+        if "must be called before" in msg and jax.process_count() > 1:
+            # Backend already live AND already multi-process: a legitimate
+            # idempotent re-entry (the application bootstrapped distributed
+            # before calling train). If the live backend is single-process,
+            # the explicit multi-host request genuinely failed — re-raise
+            # rather than silently training N independent copies.
+            return True
         raise
 
 

@@ -1,0 +1,23 @@
+"""One shared platform-selection guard for every entry point.
+
+This image's site customization programmatically rewrites JAX's platform
+selection after import, so exporting JAX_PLATFORMS alone is NOT honored —
+the value must be re-asserted through jax.config after importing jax.
+Every CLI/benchmark entry point (train.main, ladder.main, bench.py,
+__graft_entry__.py) calls this before its first JAX operation; keeping it
+in one place keeps the workaround from drifting between copies.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Re-assert the JAX_PLATFORMS env var (when set) via jax.config, which
+    survives site customizations that override plain env-var selection.
+    Must run before the first operation that initializes an XLA backend."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -16,11 +16,19 @@ packed [capacity, D] ring in device memory:
     sample_chunk path) — jax.random indices + gather per scan step, so a
     K-step chunk needs ZERO transfers in and only td/metrics out.
 
-ptr/size/PRNG key live on device; nothing round-trips. Multi-host note:
-storage is replicated over the mesh; insert blocks must be globally
-identical SPMD inputs, so multi-host callers build the global block with
-jax.make_array_from_process_local_data before insert (see
-parallel/multihost.py docstring).
+ptr/size/PRNG key live on device; nothing round-trips.
+
+Multi-host: storage is replicated over the (possibly process-spanning)
+mesh, so every process must execute the IDENTICAL insert sequence on the
+identical global block — per-process-local inserts would silently fork the
+replicas. `add_packed` therefore only buffers host-side when
+jax.process_count() > 1, and `sync_ship()` — which all processes must call
+at the same point (train_jax: once per learner chunk) — ships
+min-over-processes full blocks: each process contributes its local rows
+via jax.make_array_from_process_local_data sharded over the mesh's 'data'
+axis, and the jitted insert's replicated output sharding makes XLA
+all-gather the block (ICI within host, DCN across) into every replica.
+Single-process keeps the inline fast path; sync_ship degrades to flush.
 """
 
 from __future__ import annotations
@@ -78,8 +86,7 @@ class DeviceReplay:
             ),
         )
 
-        @donate
-        def _insert(storage, block, ptr, size):
+        def _insert_impl(storage, block, ptr, size):
             m = block.shape[0]
             idx = (ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
             storage = storage.at[idx].set(block)
@@ -87,17 +94,47 @@ class DeviceReplay:
             new_size = jnp.minimum(size + m, self.capacity)
             return storage, new_ptr, new_size
 
-        self._insert = _insert
+        self._insert = donate(_insert_impl)
+
+        # Multi-host ingest (see module docstring): a second compiled insert
+        # whose block input is SHARDED over the data axis — each process
+        # feeds its local rows, XLA all-gathers into the replicated storage.
+        self._procs = jax.process_count() if mesh is not None else 1
+        if self._procs > 1:
+            global_rows = self._procs * self.block_size
+            if global_rows % mesh.shape["data"]:
+                raise ValueError(
+                    f"block_size {self.block_size} x {self._procs} processes "
+                    f"must divide evenly over data axis {mesh.shape['data']}"
+                )
+            self._block_sharding = NamedSharding(mesh, P("data", None))
+            self._insert_global = jax.jit(
+                _insert_impl,
+                donate_argnums=(0,),
+                in_shardings=(
+                    sharding, self._block_sharding, scalar_sharding, scalar_sharding
+                ),
+                out_shardings=(sharding, scalar_sharding, scalar_sharding),
+            )
 
     def __len__(self) -> int:
         return int(jax.device_get(self.size))
+
+    @property
+    def pending_rows(self) -> int:
+        """Host-side rows buffered but not yet shipped (multi-host: waiting
+        for the lockstep sync_ship; callers use this for backpressure)."""
+        return len(self._pending)
 
     # --- host -> HBM ingestion ---
 
     def add_packed(self, block: np.ndarray) -> None:
         """Buffer packed [M, D] rows host-side; ship in fixed-size blocks
-        (fixed shapes -> one compiled insert, no retrace churn)."""
+        (fixed shapes -> one compiled insert, no retrace churn). Multi-host:
+        buffers ONLY — rows leave via the lockstep sync_ship()."""
         self._pending = np.concatenate([self._pending, block.astype(np.float32)])
+        if self._procs > 1:
+            return
         while len(self._pending) >= self.block_size:
             chunk, self._pending = (
                 self._pending[: self.block_size],
@@ -108,13 +145,74 @@ class DeviceReplay:
     def flush(self, min_rows: int = 1) -> None:
         """Force pending rows out (padded by repetition to the block shape —
         only used at warmup / shutdown, so the tiny duplication bias is
-        confined to the first/last block)."""
+        confined to the first/last block). Single-process only; multi-host
+        callers use sync_ship(force=True)."""
+        if self._procs > 1:
+            raise RuntimeError("flush() is per-process; use sync_ship() "
+                               "in multi-host runs")
         n = len(self._pending)
         if n >= min_rows and n > 0:
             reps = -(-self.block_size // n)
             chunk = np.tile(self._pending, (reps, 1))[: self.block_size]
             self._pending = np.zeros((0, self.width), np.float32)
             self._ship(chunk)
+
+    def sync_ship(self, force: bool = False) -> int:
+        """Multi-host-safe ingest step. ALL processes must call this at the
+        same point in their loop (train_jax: once per learner chunk) — it
+        all-gathers pending counts and ships exactly min-over-processes
+        full blocks, so every process executes the identical sequence of
+        global device ops on a consistently-sharded block.
+
+        force=True additionally pads one block from the remainders (only
+        when every process holds >= 1 pending row) — warmup/shutdown use.
+        Returns locally shipped real (unpadded) rows. Single-process it
+        degrades to the add_packed/flush fast path."""
+        if self._procs == 1:
+            moved = 0
+            while len(self._pending) >= self.block_size:
+                chunk, self._pending = (
+                    self._pending[: self.block_size],
+                    self._pending[self.block_size :],
+                )
+                self._ship(chunk)
+                moved += self.block_size
+            if force and len(self._pending):
+                moved += len(self._pending)
+                self.flush()
+            return moved
+
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.int32(len(self._pending)))
+        )
+        m = int(counts.min())
+        moved = 0
+        for _ in range(m // self.block_size):
+            chunk, self._pending = (
+                self._pending[: self.block_size],
+                self._pending[self.block_size :],
+            )
+            self._ship_global(chunk)
+            moved += self.block_size
+        if force and m % self.block_size:
+            take = min(len(self._pending), self.block_size)
+            chunk, self._pending = self._pending[:take], self._pending[take:]
+            reps = -(-self.block_size // take)
+            self._ship_global(np.tile(chunk, (reps, 1))[: self.block_size])
+            moved += take
+        return moved
+
+    def _ship_global(self, local_rows: np.ndarray) -> None:
+        block = jax.make_array_from_process_local_data(
+            self._block_sharding,
+            np.ascontiguousarray(local_rows, np.float32),
+            (self._procs * self.block_size, self.width),
+        )
+        self.storage, self.ptr, self.size = self._insert_global(
+            self.storage, block, self.ptr, self.size
+        )
 
     def _ship(self, chunk: np.ndarray) -> None:
         if self._mesh is not None:

@@ -266,7 +266,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     from distributed_ddpg_tpu.replay.device import DeviceReplay
     from distributed_ddpg_tpu.types import pack_batch_np
 
-    multihost.initialize()
+    is_multi = multihost.initialize()
     env = make(config.env_id, seed=config.seed)
     spec = spec_of(env)
     chunk = 8  # learner steps per dispatch (lax.scan)
@@ -345,29 +345,60 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     def drain() -> int:
         # Ingest rate limiter (config.max_ingest_ratio): when the budget is
         # exhausted, skip draining — transports fill and workers block,
-        # throttling env stepping until the learner catches up.
+        # throttling env stepping until the learner catches up. The budget
+        # also CAPS each drain (max_rows): after a long gap (first-chunk
+        # compile) the rings hold thousands of buffered steps, and draining
+        # them all at once would blow straight past the ratio (and possibly
+        # total_env_steps) in one call.
+        if (
+            use_device_replay
+            and is_multi
+            and device_replay.pending_rows >= 8 * device_replay.block_size
+        ):
+            # Backpressure: sync_ship only moves min-over-processes blocks,
+            # so a host whose actors outpace the slowest host would grow
+            # _pending without bound. Stop draining instead — the rings
+            # fill and that host's workers block until the pod catches up.
+            return 0
+        max_rows = None
         if config.max_ingest_ratio > 0.0:
             allowed = (
                 max(config.replay_min_size, config.batch_size)
                 + config.max_ingest_ratio * learn_steps
             )
-            if env_steps() >= allowed:
+            max_rows = int(allowed) - env_steps()
+            if max_rows <= 0:
                 return 0
         if use_device_replay:
             moved = 0
-            batches = pool.drain_batches()
+            batches = pool.drain_batches(max_rows=max_rows)
             for batch in batches:
                 device_replay.add_packed(pack_batch_np(batch))
                 moved += len(batch["reward"])
             return moved
         with replay_lock:
-            return pool.drain_into(replay)
+            return pool.drain_into(replay, max_rows=max_rows)
 
     def buffer_fill() -> int:
         return len(device_replay) if use_device_replay else len(replay)
 
     def env_steps() -> int:
         return env_steps_offset + pool.steps_received
+
+    def global_env_steps() -> int:
+        """SUM of env steps over processes, all-gathered so every process
+        sees the identical number. The loop condition must be globally
+        agreed — a process-local condition would let processes exit at
+        different iterations and deadlock the rest on the next collective.
+        (total_env_steps is therefore a GLOBAL budget on multi-host runs:
+        64 actors across 4 hosts share it.)"""
+        from jax.experimental import multihost_utils
+
+        return int(
+            np.asarray(
+                multihost_utils.process_allgather(np.int64(env_steps()))
+            ).sum()
+        )
 
     next_refresh = 0
     last_eval = 0
@@ -377,6 +408,13 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         learn_steps += chunk
         learn_timer.tick(chunk)
         env_timer.tick(drain())
+        if use_device_replay and is_multi:
+            # Lockstep multi-host ingest (replay/device.py sync_ship): every
+            # process executes the identical global inserts here, once per
+            # chunk — local add_packed only buffered. Unconditional: the
+            # ingest gate above is computed from process-LOCAL counters, so
+            # it cannot be allowed to skip a collective on some processes.
+            device_replay.sync_ship()
 
         if config.prioritized:
             tds = np.asarray(out.td_errors).reshape(-1)
@@ -425,6 +463,9 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         if (
             config.checkpoint_dir
             and learn_steps - last_ckpt >= config.checkpoint_every
+            # Learner state + device replay are replicated across processes,
+            # so one writer suffices (and shared-FS writes must not collide).
+            and jax.process_index() == 0
         ):
             ckpt_lib.save(
                 config.checkpoint_dir, learn_steps, learner.state,
@@ -436,16 +477,27 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     try:
         # --- warmup: fill replay to the learning threshold ---
         min_fill = max(config.replay_min_size, config.batch_size)
+        warm_it = 0
         while buffer_fill() < min_fill:
             moved = drain()
             env_timer.tick(moved)
             pool.monitor()
-            if use_device_replay and moved and buffer_fill() + len(
-                device_replay._pending
-            ) >= min_fill:
-                device_replay.flush()
+            if use_device_replay:
+                if is_multi:
+                    # Lockstep warmup ingest: loop count is driven by the
+                    # globally-replicated buffer size and `warm_it` advances
+                    # identically everywhere, so every process calls
+                    # sync_ship (a collective) the same number of times.
+                    # Periodic force pads a block from sub-block trickles so
+                    # slow actors still cross the threshold.
+                    device_replay.sync_ship(force=(warm_it % 20 == 19))
+                elif moved and buffer_fill() + len(
+                    device_replay._pending
+                ) >= min_fill:
+                    device_replay.flush()
             if not moved:
                 time.sleep(0.05)
+            warm_it += 1
 
         prefetch = None
         if not use_device_replay:
@@ -459,7 +511,22 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         env_timer.reset()
 
         with profile_cm:
-            while env_steps() < config.total_env_steps:
+            # Multi-host: the global budget is re-gathered every 10th
+            # iteration, not every chunk — the cadence is deterministic in
+            # the (lockstep) iteration count, so processes stay in step,
+            # and the hot loop pays one budget collective per 10 chunks
+            # instead of one per chunk. Overshoot is bounded by 10 chunks
+            # of ingest — noise against BASELINE-scale budgets.
+            it = 0
+            cached_global = 0
+            while True:
+                if is_multi:
+                    if it % 10 == 0:
+                        cached_global = global_env_steps()
+                    if cached_global >= config.total_env_steps:
+                        break
+                elif env_steps() >= config.total_env_steps:
+                    break
                 if use_device_replay:
                     out = learner.run_sample_chunk(device_replay)
                     after_chunk(out, None)
@@ -467,6 +534,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                     device_chunk, indices = prefetch.next()
                     out = learner.run_chunk_async(device_chunk)
                     after_chunk(out, indices)
+                it += 1
 
         if prefetch is not None:
             prefetch.stop()
@@ -484,10 +552,20 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         final_return=final_return,
     )
     log.close()
+    # Checksum of the final actor params: lets determinism tests (and the
+    # multi-host parity test — SPMD replicas must agree bit-for-bit)
+    # compare end states without plumbing the whole state out.
+    checksum = float(
+        sum(
+            np.abs(np.asarray(leaf)).sum()
+            for leaf in jax.tree.leaves(learner.actor_params_to_host())
+        )
+    )
     return {
         "learner_steps_per_sec": rate,
         "learner_steps": learn_steps,
         "final_return": final_return,
+        "param_checksum": checksum,
     }
 
 
@@ -507,15 +585,9 @@ def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None
 
 
 def main(argv=None) -> None:
-    # Honor an explicit JAX_PLATFORMS even where a site customization
-    # programmatically overrides it (same fix as __graft_entry__.py) —
-    # e.g. JAX_PLATFORMS=cpu smoke runs on a TPU-attached host.
-    import os
+    from distributed_ddpg_tpu.platform_util import honor_jax_platforms
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    honor_jax_platforms()
     config = DDPGConfig.from_flags(argv if argv is not None else sys.argv[1:])
     summary = train(config)
     print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})

@@ -32,12 +32,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_learner_parity():
+def _run_pair(mode: str, timeout: int = 240):
+    """Launch 2 jax.distributed CPU processes in the given child mode;
+    return the sorted PARITY payloads (one per process)."""
     port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(CHILD), str(pid), "2", str(port)],
+            [sys.executable, str(CHILD), str(pid), "2", str(port), mode],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -48,16 +49,20 @@ def test_two_process_learner_parity():
     ]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=240)
+        out, _ = p.communicate(timeout=timeout)
         outs.append(out)
         assert p.returncode == 0, f"child failed:\n{out}"
-
     parity = sorted(
         line.split()[1:] for o in outs for line in o.splitlines()
         if line.startswith("PARITY")
     )
     assert len(parity) == 2, f"expected 2 parity lines, got {parity}\n{outs}"
-    (_, loss0, sum0), (_, loss1, sum1) = parity
+    return parity
+
+
+@pytest.mark.slow
+def test_two_process_learner_parity():
+    (_, loss0, sum0), (_, loss1, sum1) = _run_pair("chunk")
     assert loss0 == loss1, f"cross-process loss mismatch: {loss0} vs {loss1}"
     assert sum0 == sum1, f"cross-process param mismatch: {sum0} vs {sum1}"
 
@@ -89,3 +94,27 @@ def test_two_process_learner_parity():
     _, loss_s, sum_s = single
     assert abs(float(loss0) - float(loss_s)) < 1e-5, (loss0, loss_s)
     assert abs(float(sum0) - float(sum_s)) < 1e-3, (sum0, sum_s)
+
+
+@pytest.mark.slow
+def test_two_process_device_replay_ingest():
+    """Lockstep DeviceReplay ingest (sync_ship): each process contributes
+    different rows; the replicated storage must come out identical on both
+    replicas and contain both processes' rows exactly once (the round-1
+    SPMD violation — per-process-local inserts — would fail the in-child
+    checksum assertions and diverge the sampled-chunk loss)."""
+    (_, loss0, store0), (_, loss1, store1) = _run_pair("replay")
+    assert loss0 == loss1, f"sampled-chunk loss mismatch: {loss0} vs {loss1}"
+    assert store0 == store1, f"storage checksum mismatch: {store0} vs {store1}"
+
+
+@pytest.mark.slow
+def test_two_process_full_train_jax():
+    """The FULL train_jax loop (actor pool -> lockstep device-replay ingest
+    -> fused-sampling sharded learner -> globally-summed env-step budget)
+    across 2 jax.distributed processes. Both processes must run the same
+    number of learner steps (lockstep) and end with bit-identical actor
+    params (SPMD consistency)."""
+    (_, steps0, ck0), (_, steps1, ck1) = _run_pair("train", timeout=360)
+    assert steps0 == steps1, f"learner step mismatch: {steps0} vs {steps1}"
+    assert ck0 == ck1, f"param checksum mismatch: {ck0} vs {ck1}"
