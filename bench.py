@@ -180,16 +180,23 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=CHUNK) -> dict
 
     steps = 0
     ingested = 0.0
+    t_dispatch = t_ingest = 0.0
+    dispatches = 0
     t0 = time.perf_counter()
     deadline = t0 + seconds
     while time.perf_counter() < deadline:
+        t1 = time.perf_counter()
         out = learner.run_sample_chunk(device_replay)
+        t_dispatch += time.perf_counter() - t1
+        dispatches += 1
         steps += chunk
         # Ship actor blocks at the modeled ingest rate.
-        due = (time.perf_counter() - t0) * actor_rate
+        t1 = time.perf_counter()
+        due = (t1 - t0) * actor_rate
         while ingested + 4096 <= due:
             device_replay.add_packed(ingest_rows)
             ingested += 4096
+        t_ingest += time.perf_counter() - t1
     _ = float(out.metrics["critic_loss"])  # sync on the last chunk
     elapsed = time.perf_counter() - t0
     rate = steps / elapsed
@@ -202,6 +209,10 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=CHUNK) -> dict
         "device_kind": dev.device_kind,
         "n_devices": n_dev,
         "per_device_rate": rate / n_dev,
+        # Per-phase breakdown (SURVEY.md §5): mean chunk dispatch(+compute
+        # backpressure) time vs actor-ingest h2d time per loop iteration.
+        "t_dispatch_ms": round(1000.0 * t_dispatch / max(dispatches, 1), 3),
+        "t_ingest_ms": round(1000.0 * t_ingest / max(dispatches, 1), 3),
     }
     peak = _peak_flops(dev.device_kind)
     if peak is not None:
@@ -361,6 +372,9 @@ def main() -> int:
         result["device_kind"] = accel["device_kind"]
         result["n_devices"] = accel["n_devices"]
         result["per_device_rate"] = round(accel["per_device_rate"], 1)
+        for key in ("t_dispatch_ms", "t_ingest_ms"):
+            if key in accel:
+                result[key] = accel[key]
         if "mfu" in accel:
             result["mfu"] = round(accel["mfu"], 5)
         if native:

@@ -47,6 +47,32 @@ COMPAT_FIELDS = (
 )
 
 
+def _snapshot(
+    step: int, state: TrainState, replay, env_steps: int
+) -> Dict[str, Any]:
+    """Materialize everything host-side. This is the only part that touches
+    device memory; once it returns, the learner is free to mutate/donate
+    its state — the write can proceed on any thread."""
+    ckpt: Dict[str, Any] = {
+        "state": jax.device_get(state),
+        "meta": {"env_steps": np.asarray(env_steps, np.int64)},
+    }
+    if replay is not None:
+        ckpt["replay"] = replay.state_dict()
+    return ckpt
+
+
+def _write(directory: str, step: int, ckpt: Dict[str, Any],
+           config: Optional[DDPGConfig]) -> str:
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, ckpt)
+    if config is not None:
+        with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
+            json.dump(dataclasses.asdict(config), f, indent=2, default=list)
+    return path
+
+
 def save(
     directory: str,
     step: int,
@@ -55,20 +81,72 @@ def save(
     config: Optional[DDPGConfig] = None,
     env_steps: int = 0,
 ) -> str:
-    """Write checkpoint `directory/step_N`. Returns the path."""
-    path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    ckpt: Dict[str, Any] = {
-        "state": jax.device_get(state),
-        "meta": {"env_steps": np.asarray(env_steps, np.int64)},
-    }
-    if replay is not None:
-        ckpt["replay"] = replay.state_dict()
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, ckpt)
-    if config is not None:
-        with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
-            json.dump(dataclasses.asdict(config), f, indent=2, default=list)
-    return path
+    """Write checkpoint `directory/step_N` synchronously. Returns the path."""
+    return _write(directory, step, _snapshot(step, state, replay, env_steps), config)
+
+
+class AsyncSaver:
+    """Checkpointing off the hot loop (SURVEY.md §5 'async save off the hot
+    loop'; VERDICT.md round-1 Weak #6). save_async snapshots device state on
+    the caller's thread — one HBM->host copy, fast at memory bandwidth —
+    and hands serialization + the multi-hundred-MB disk write to a single
+    background writer. If the writer is still busy when the next cadence
+    fires, that save is SKIPPED (coalesced): a fresher checkpoint is always
+    coming, and queueing would grow host memory by a full replay copy per
+    backlog entry."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.skipped = 0
+        self.errors: list = []
+
+    @property
+    def busy(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def save_async(
+        self,
+        directory: str,
+        step: int,
+        state: TrainState,
+        replay=None,
+        config: Optional[DDPGConfig] = None,
+        env_steps: int = 0,
+    ) -> bool:
+        """Snapshot now, write in the background. Returns False (and skips)
+        if the previous write is still in flight."""
+        import threading
+
+        with self._lock:
+            if self.busy:
+                self.skipped += 1
+                return False
+            ckpt = _snapshot(step, state, replay, env_steps)
+
+            def _run():
+                try:
+                    _write(directory, step, ckpt, config)
+                except Exception as e:  # surfaced via .errors / wait()
+                    self.errors.append(e)
+
+            self._thread = threading.Thread(
+                target=_run, name=f"ckpt-writer-{step}", daemon=True
+            )
+            self._thread.start()
+            return True
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) lands; re-raise its
+        error if it failed. Call before reading back a checkpoint or at
+        shutdown."""
+        t = self._thread
+        if t is not None:
+            t.join()
+        if self.errors:
+            raise self.errors[-1]
 
 
 def check_config_compatible(directory: str, step: int, config: DDPGConfig) -> None:

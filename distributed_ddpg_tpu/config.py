@@ -49,6 +49,11 @@ class DDPGConfig:
     per_beta: float = 0.4
     per_beta_final: float = 1.0
     per_eps: float = 1e-6
+    # Force the host replay + prefetch pipeline in train_jax instead of the
+    # HBM-resident DeviceReplay. The fallback for buffers too large for
+    # device memory; the device path (uniform AND prioritized) is the
+    # flagship zero-h2d steady state.
+    host_replay: bool = False
 
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15

@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 import warnings
+from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
 
@@ -23,6 +25,9 @@ class MetricsLogger:
         self._file = open(path, "a", buffering=1) if path else None
         self._echo = echo
         self._t0 = time.time()
+        # log() is called from the train loop AND from the background eval
+        # thread (train.py); serialize sinks so JSONL lines never interleave.
+        self._lock = threading.Lock()
         self._tb = None
         if tb_dir:
             try:
@@ -43,15 +48,16 @@ class MetricsLogger:
             **{k: _jsonable(v) for k, v in fields.items()},
         }
         line = json.dumps(rec)
-        if self._file:
-            self._file.write(line + "\n")
-        if self._echo:
-            print(line, file=sys.stdout, flush=True)
-        if self._tb is not None:
-            for k, v in rec.items():
-                if k in ("kind", "step") or not isinstance(v, (int, float)):
-                    continue
-                self._tb.add_scalar(f"{kind}/{k}", v, step)
+        with self._lock:
+            if self._file:
+                self._file.write(line + "\n")
+            if self._echo:
+                print(line, file=sys.stdout, flush=True)
+            if self._tb is not None:
+                for k, v in rec.items():
+                    if k in ("kind", "step") or not isinstance(v, (int, float)):
+                        continue
+                    self._tb.add_scalar(f"{kind}/{k}", v, step)
         return rec
 
     def close(self) -> None:
@@ -66,6 +72,42 @@ def _jsonable(v):
         return round(float(v), 6)
     except (TypeError, ValueError):
         return v
+
+
+class PhaseTimers:
+    """Cumulative per-phase wall-time counters (SURVEY.md §5 'per-step
+    timing of sample→h2d→step→d2h'; VERDICT.md round-1 Weak #9). Phases are
+    whatever the caller brackets — train_jax uses dispatch (chunk submit),
+    ingest (actor h2d), sync (metrics d2h), sample_wait (host-prefetch
+    starvation), ckpt, eval_snapshot. snapshot() emits `t_<name>_ms` mean
+    per call + `n_<name>` counts and resets, so each JSONL train record
+    carries the breakdown for its own interval — feed starvation at 20x
+    learner speed shows up as ingest/sample_wait growth, not guesswork."""
+
+    def __init__(self):
+        self._acc: Dict[str, float] = {}
+        self._n: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._n[name] = self._n.get(name, 0) + 1
+
+    def snapshot(self, reset: bool = True) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, total in self._acc.items():
+            n = max(self._n.get(name, 1), 1)
+            out[f"t_{name}_ms"] = round(1000.0 * total / n, 3)
+            out[f"n_{name}"] = self._n.get(name, 0)
+        if reset:
+            self._acc.clear()
+            self._n.clear()
+        return out
 
 
 class Timer:

@@ -233,7 +233,55 @@ class ShardedLearner:
 
             sample_chunk_fn = fused_sample_chunk_fn
 
+        # PER fused chunk (replay/device.py DevicePrioritizedReplay,
+        # VERDICT.md round-1 Missing #4): stratified proportional draw from
+        # the device-resident priority vector, IS-weighted scan, and the
+        # (|td|+eps)^alpha scatter update — one dispatch, zero h2d. The
+        # priority vector is donated in and handed back updated.
+        from distributed_ddpg_tpu.replay.device import draw_per_indices
+
+        def per_sample_chunk_fn(s, key, storage, size, priorities, maxp,
+                                beta, alpha, eps):
+            key, sub = jax.random.split(key)
+            idx, weights = draw_per_indices(
+                sub, priorities, size, (self.chunk_size, batch_size), beta
+            )
+            packed = storage[idx]
+            packed = jax.lax.with_sharding_constraint(
+                packed, NamedSharding(self.mesh, P(None, "data", None))
+            )
+            weights = jax.lax.with_sharding_constraint(
+                weights, NamedSharding(self.mesh, P(None, "data"))
+            )
+            batches = unpack_batch(packed, obs_dim, act_dim)._replace(
+                weight=weights
+            )
+            out = scan_steps(s, batches)
+            new_p = (jnp.abs(out.td_errors) + eps) ** alpha
+            priorities = priorities.at[idx.reshape(-1)].set(new_p.reshape(-1))
+            maxp = jnp.maximum(maxp, new_p.max())
+            return out, key, priorities, maxp
+
         storage_sharding = NamedSharding(self.mesh, P(None, None))
+        prio_sharding = NamedSharding(self.mesh, P(None))
+        self._per_sample_chunk_step = jax.jit(
+            per_sample_chunk_fn,
+            in_shardings=(
+                self._state_sharding, replicated, storage_sharding, replicated,
+                prio_sharding, replicated, replicated, replicated, replicated,
+            ),
+            out_shardings=(
+                StepOutput(
+                    state=self._state_sharding,
+                    td_errors=NamedSharding(self.mesh, P(None, "data")),
+                    metrics={k: replicated for k in METRIC_KEYS},
+                ),
+                replicated,
+                prio_sharding,
+                replicated,
+            ),
+            donate_argnums=(0, 1, 4),
+        )
         self._sample_chunk_step = jax.jit(
             sample_chunk_fn,
             in_shardings=(self._state_sharding, replicated, storage_sharding, replicated),
@@ -286,6 +334,21 @@ class ShardedLearner:
         storage, size = device_replay.device_state()
         out, self._key = self._sample_chunk_step(self.state, self._key, storage, size)
         self.state = out.state
+        return out
+
+    def run_sample_chunk_per(self, device_replay, beta: float) -> StepOutput:
+        """K learner steps with proportional PER sampling + priority update
+        fused on device (DevicePrioritizedReplay) — the same zero-h2d
+        steady state as the uniform path; beta anneals host-side and rides
+        in as a scalar argument."""
+        storage, size, priorities, maxp = device_replay.per_state()
+        out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
+            self.state, self._key, storage, size, priorities, maxp,
+            np.float32(beta), np.float32(device_replay.alpha),
+            np.float32(device_replay.eps),
+        )
+        self.state = out.state
+        device_replay.set_per_state(new_p, new_maxp)
         return out
 
     # --- host-side views ---

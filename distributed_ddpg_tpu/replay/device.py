@@ -259,3 +259,153 @@ class DeviceReplay:
             scalar = NamedSharding(self._mesh, P())
             self.ptr = jax.device_put(self.ptr, scalar)
             self.size = jax.device_put(self.size, scalar)
+
+
+def draw_per_indices(key, priorities, size, shape, beta):
+    """Stratified proportional PER draw, fully on device (the TPU-native
+    replacement for the host sum-tree walk, replay/prioritized.py): one
+    cumsum over the priority vector + a vectorized searchsorted — O(cap)
+    memory-bandwidth + O(n log cap) compare ops, no branchy tree descent.
+
+    shape = (K, B): K scan steps of B samples, stratified within each B
+    (mirroring SumTree.stratified_sample). Returns (idx[K,B], weights[K,B])
+    with IS weights w = (size * p/total)^-beta normalized per B-batch by
+    its max (exactly the host formula).
+
+    f32 cumsum note: with ~1e6 priorities the running total's f32 ulp is
+    ~0.06 at total ~1e6, so individual sample boundaries can shift by
+    O(ulp/total) probability mass — negligible against PER's own eps floor;
+    the host tree keeps f64 and the parity test bounds the difference."""
+    k, b = shape
+    cum = jnp.cumsum(priorities)
+    total = cum[-1]
+    u = (jnp.arange(b, dtype=jnp.float32)[None, :]
+         + jax.random.uniform(key, (k, b))) / b * total
+    idx = jnp.searchsorted(cum, u.reshape(-1), side="right").reshape(k, b)
+    idx = jnp.minimum(idx.astype(jnp.int32), jnp.maximum(size - 1, 0))
+    probs = priorities[idx] / jnp.maximum(total, 1e-12)
+    weights = (size.astype(jnp.float32) * jnp.maximum(probs, 1e-12)) ** (-beta)
+    weights = weights / jnp.max(weights, axis=-1, keepdims=True)
+    return idx, weights
+
+
+class DevicePrioritizedReplay(DeviceReplay):
+    """Proportional PER with priorities resident in HBM (SURVEY.md §7 hard
+    part (a) applied to PER; VERDICT.md round-1 Missing #4).
+
+    The host PrioritizedReplay keeps a sum-tree on CPU, which forces the
+    flagship path back to host sampling + per-chunk h2d transfers. Here the
+    priority vector is a replicated f32[capacity] device array:
+
+      - inserts stamp new rows with the running max priority (same
+        every-transition-seen-once rule as the host buffer) inside a jitted
+        scatter chained onto the storage insert;
+      - sampling is draw_per_indices fused INTO the learner chunk
+        (ShardedLearner.run_sample_chunk on a prioritized replay) — zero
+        h2d, zero d2h for priorities;
+      - priority updates scatter (|td|+eps)^alpha for the chunk's sampled
+        indices at chunk end — the same once-per-chunk cadence the host
+        path has (update_priorities is called once per after_chunk).
+
+    Multi-host: priorities/max_priority are replicated like storage, and
+    every update is computed from replicated inputs (state, key, td), so
+    replicas stay identical with no extra collectives."""
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int,
+        act_dim: int,
+        mesh: Optional[Mesh] = None,
+        block_size: int = 4096,
+        seed: int = 0,
+        alpha: float = 0.6,
+        eps: float = 1e-6,
+    ):
+        super().__init__(capacity, obs_dim, act_dim, mesh=mesh,
+                         block_size=block_size, seed=seed)
+        self.alpha = float(alpha)
+        self.eps = float(eps)
+        vec_sharding = NamedSharding(mesh, P(None)) if mesh is not None else None
+        scalar_sharding = NamedSharding(mesh, P()) if mesh is not None else None
+        self.priorities = jnp.zeros((self.capacity,), jnp.float32)
+        self.max_priority = jnp.ones((), jnp.float32)
+        if vec_sharding is not None:
+            self.priorities = jax.device_put(self.priorities, vec_sharding)
+            self.max_priority = jax.device_put(self.max_priority, scalar_sharding)
+
+        def make_stamp(m: int):
+            def stamp(prios, maxp, old_ptr):
+                idx = (old_ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
+                return prios.at[idx].set(maxp)
+
+            kwargs = (
+                dict(
+                    in_shardings=(vec_sharding, scalar_sharding, scalar_sharding),
+                    out_shardings=vec_sharding,
+                )
+                if vec_sharding is not None
+                else {}
+            )
+            return jax.jit(stamp, donate_argnums=(0,), **kwargs)
+
+        self._stamp_local = make_stamp(self.block_size)
+        if self._procs > 1:
+            self._stamp_global = make_stamp(self._procs * self.block_size)
+
+    def _ship(self, chunk: np.ndarray) -> None:
+        old_ptr = self.ptr  # not donated by _insert; still valid after
+        super()._ship(chunk)
+        self.priorities = self._stamp_local(
+            self.priorities, self.max_priority, old_ptr
+        )
+
+    def _ship_global(self, local_rows: np.ndarray) -> None:
+        old_ptr = self.ptr
+        super()._ship_global(local_rows)
+        self.priorities = self._stamp_global(
+            self.priorities, self.max_priority, old_ptr
+        )
+
+    # --- state for the fused PER sampling learner path ---
+
+    def per_state(self):
+        return self.storage, self.size, self.priorities, self.max_priority
+
+    def set_per_state(self, priorities, max_priority) -> None:
+        """Install the updated priority vector returned by the learner's
+        fused chunk (both already carry the replicated sharding)."""
+        self.priorities = priorities
+        self.max_priority = max_priority
+
+    # --- checkpoint support ---
+
+    def state_dict(self):
+        state = super().state_dict()
+        n = int(state["size"])
+        prios = np.asarray(jax.device_get(self.priorities))
+        state["priorities"] = prios[:n].copy()
+        state["max_priority"] = np.asarray(
+            float(jax.device_get(self.max_priority))
+        )
+        return state
+
+    def load_state_dict(self, state) -> None:
+        super().load_state_dict(state)
+        if "priorities" in state:
+            n = int(state["size"])
+            prios = np.array(jax.device_get(self.priorities))
+            prios[:n] = state["priorities"]
+            vec_sharding = (
+                NamedSharding(self._mesh, P(None)) if self._mesh is not None else None
+            )
+            scalar = (
+                NamedSharding(self._mesh, P()) if self._mesh is not None else None
+            )
+            self.priorities = jnp.asarray(prios)
+            self.max_priority = jnp.asarray(
+                float(state["max_priority"]), jnp.float32
+            )
+            if vec_sharding is not None:
+                self.priorities = jax.device_put(self.priorities, vec_sharding)
+                self.max_priority = jax.device_put(self.max_priority, scalar)

@@ -27,7 +27,7 @@ import numpy as np
 from distributed_ddpg_tpu import checkpoint as ckpt_lib
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs import make, spec_of
-from distributed_ddpg_tpu.metrics import MetricsLogger, Timer
+from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, Timer
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
 
@@ -263,7 +263,10 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     from distributed_ddpg_tpu.parallel.learner import ShardedLearner
     from distributed_ddpg_tpu.parallel.prefetch import ChunkPrefetcher
 
-    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.replay.device import (
+        DevicePrioritizedReplay,
+        DeviceReplay,
+    )
     from distributed_ddpg_tpu.types import pack_batch_np
 
     is_multi = multihost.initialize()
@@ -278,21 +281,26 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         spec.action_offset,
         chunk_size=chunk,
     )
-    # Uniform replay lives ON DEVICE (zero h2d in the steady state,
-    # replay/device.py); PER keeps the host sum-tree + prefetch pipeline
-    # (priorities are host state).
-    use_device_replay = not config.prioritized
-    device_replay = (
-        DeviceReplay(
-            config.replay_capacity,
-            spec.obs_dim,
-            spec.act_dim,
-            mesh=learner.mesh,
-            block_size=1024,
+    # Replay lives ON DEVICE (zero h2d in the steady state) for both
+    # uniform and prioritized modes (replay/device.py; the PER priority
+    # vector is device-resident too). config.host_replay forces the host
+    # buffer + prefetch pipeline — the fallback for buffers beyond HBM.
+    use_device_replay = not config.host_replay
+    if use_device_replay:
+        replay_kwargs = dict(mesh=learner.mesh, block_size=1024)
+        device_replay = (
+            DevicePrioritizedReplay(
+                config.replay_capacity, spec.obs_dim, spec.act_dim,
+                alpha=config.per_alpha, eps=config.per_eps, **replay_kwargs,
+            )
+            if config.prioritized
+            else DeviceReplay(
+                config.replay_capacity, spec.obs_dim, spec.act_dim,
+                **replay_kwargs,
+            )
         )
-        if use_device_replay
-        else None
-    )
+    else:
+        device_replay = None
     replay = None if use_device_replay else make_replay(config, spec.obs_dim, spec.act_dim)
     pool = ActorPool(config, spec)
     # --- resume (SURVEY.md §3.5/§5: learner restart = checkpoint restore;
@@ -322,12 +330,44 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     pool.start(learner.actor_params_to_host())
     log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
     learn_timer, env_timer = Timer(), Timer()
+    phases = PhaseTimers()
+    saver = ckpt_lib.AsyncSaver()
     last_ckpt = learn_steps
     eval_policy = NumpyPolicy(
         param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)),
         spec.action_scale,
         spec.action_offset,
     )
+
+    # Periodic eval runs in a background thread on a PARAM SNAPSHOT
+    # (SURVEY.md §5; VERDICT.md round-1 Weak #7: inline eval stalled the
+    # learner for whole CPU episodes). Only the tiny flat-param copy happens
+    # on the hot loop; if an eval is still running when the next cadence
+    # fires, the new one is skipped — eval is a diagnostic, the learner has
+    # priority.
+    eval_thread: Dict[str, object] = {"t": None}
+
+    def start_eval(at_step: int) -> None:
+        t = eval_thread["t"]
+        if t is not None and t.is_alive():
+            return
+        with phases.phase("eval_snapshot"):
+            flat = flatten_params(learner.actor_params_to_host())
+
+        def _run():
+            policy = NumpyPolicy(
+                param_layout(
+                    spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)
+                ),
+                spec.action_scale,
+                spec.action_offset,
+            )
+            policy.load_flat(flat)
+            log.log("eval", at_step, eval_return=_eval_numpy(policy, config, spec))
+
+        t = threading.Thread(target=_run, name="eval-worker", daemon=True)
+        t.start()
+        eval_thread["t"] = t
 
     profile_cm = (
         jax.profiler.trace(config.profile_dir)
@@ -407,7 +447,8 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         nonlocal learn_steps, last_ckpt, next_refresh, last_eval
         learn_steps += chunk
         learn_timer.tick(chunk)
-        env_timer.tick(drain())
+        with phases.phase("ingest"):
+            env_timer.tick(drain())
         if use_device_replay and is_multi:
             # Lockstep multi-host ingest (replay/device.py sync_ship): every
             # process executes the identical global inserts here, once per
@@ -416,15 +457,11 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             # it cannot be allowed to skip a collective on some processes.
             device_replay.sync_ship()
 
-        if config.prioritized:
-            tds = np.asarray(out.td_errors).reshape(-1)
-            with replay_lock:
-                replay.update_priorities(indices.reshape(-1), tds)
-                frac = min(1.0, env_steps() / config.total_env_steps)
-                replay.set_beta(
-                    config.per_beta
-                    + frac * (config.per_beta_final - config.per_beta)
-                )
+        if config.prioritized and not use_device_replay:
+            # Host PER: priorities live in the CPU sum-tree; the device path
+            # updates its priority vector inside the fused chunk instead.
+            with phases.phase("prio_update"):
+                _host_per_update(out, indices)
 
         # param_refresh_every is in LEARNER STEPS (config.py); refresh on
         # every crossing of a multiple (chunks advance 8 steps at a time).
@@ -438,6 +475,8 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             mean_ret = (
                 float(np.mean([e[1] for e in episodes])) if episodes else None
             )
+            with phases.phase("sync"):
+                chunk_metrics = learner.metrics_to_host(out)
             log.log(
                 "train", env_steps(),
                 learner_steps=learn_steps,
@@ -446,18 +485,15 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                 buffer_fill=buffer_fill(),
                 episode_return=mean_ret,
                 **pool.staleness(),
-                **learner.metrics_to_host(out),
+                **chunk_metrics,
+                **phases.snapshot(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
-        # deterministic CPU rollout of the current policy, off the actors'
-        # exploration path. Runs inline between chunk dispatches.
+        # deterministic CPU rollout of a param snapshot in a background
+        # thread (start_eval above) — the learner keeps dispatching.
         if config.eval_every and env_steps() - last_eval >= config.eval_every:
-            eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
-            log.log(
-                "eval", env_steps(),
-                eval_return=_eval_numpy(eval_policy, config, spec),
-            )
+            start_eval(env_steps())
             last_eval = env_steps()
 
         if (
@@ -467,12 +503,25 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             # so one writer suffices (and shared-FS writes must not collide).
             and jax.process_index() == 0
         ):
-            ckpt_lib.save(
-                config.checkpoint_dir, learn_steps, learner.state,
-                device_replay if use_device_replay else replay, config,
-                env_steps=env_steps(),
-            )
+            # Async: only the HBM->host snapshot happens here; the disk
+            # write runs on the saver's thread (checkpoint.py AsyncSaver).
+            with phases.phase("ckpt"):
+                saver.save_async(
+                    config.checkpoint_dir, learn_steps, learner.state,
+                    device_replay if use_device_replay else replay, config,
+                    env_steps=env_steps(),
+                )
             last_ckpt = learn_steps
+
+    def _host_per_update(out, indices) -> None:
+        tds = np.asarray(out.td_errors).reshape(-1)
+        with replay_lock:
+            replay.update_priorities(indices.reshape(-1), tds)
+            frac = min(1.0, env_steps() / config.total_env_steps)
+            replay.set_beta(
+                config.per_beta
+                + frac * (config.per_beta_final - config.per_beta)
+            )
 
     try:
         # --- warmup: fill replay to the learning threshold ---
@@ -523,16 +572,36 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                 if is_multi:
                     if it % 10 == 0:
                         cached_global = global_env_steps()
-                    if cached_global >= config.total_env_steps:
-                        break
-                elif env_steps() >= config.total_env_steps:
+                    budget_now = cached_global
+                else:
+                    budget_now = env_steps()
+                if budget_now >= config.total_env_steps:
                     break
                 if use_device_replay:
-                    out = learner.run_sample_chunk(device_replay)
+                    if config.prioritized:
+                        # beta anneal rides in as a scalar arg. It must be
+                        # computed from a globally-identical value
+                        # (budget_now — cached global on multi-host), NOT
+                        # process-local env steps: beta feeds the replicated
+                        # IS weights, so divergent betas would fork the
+                        # replicas.
+                        frac = min(1.0, budget_now / config.total_env_steps)
+                        beta = config.per_beta + frac * (
+                            config.per_beta_final - config.per_beta
+                        )
+                        with phases.phase("dispatch"):
+                            out = learner.run_sample_chunk_per(
+                                device_replay, beta
+                            )
+                    else:
+                        with phases.phase("dispatch"):
+                            out = learner.run_sample_chunk(device_replay)
                     after_chunk(out, None)
                 else:
-                    device_chunk, indices = prefetch.next()
-                    out = learner.run_chunk_async(device_chunk)
+                    with phases.phase("sample_wait"):
+                        device_chunk, indices = prefetch.next()
+                    with phases.phase("dispatch"):
+                        out = learner.run_chunk_async(device_chunk)
                     after_chunk(out, indices)
                 it += 1
 
@@ -540,6 +609,12 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             prefetch.stop()
     finally:
         pool.stop()
+        # Land the in-flight checkpoint write (and surface its error, if
+        # any) before callers read the directory back.
+        saver.wait()
+        t = eval_thread["t"]
+        if t is not None:
+            t.join(timeout=60)
 
     # --- final eval with the trained policy (CPU, deterministic) ---
     eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
@@ -550,6 +625,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         learner_steps=learn_steps,
         learner_steps_per_sec=rate,
         final_return=final_return,
+        **phases.snapshot(),
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the

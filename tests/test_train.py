@@ -96,7 +96,8 @@ def test_checkpoint_roundtrip_with_replay(tmp_path):
 @pytest.mark.slow
 def test_train_jax_device_replay_path(tmp_path):
     """Uniform replay -> device-resident buffer with fused on-device
-    sampling (the zero-h2d steady-state path)."""
+    sampling (the zero-h2d steady-state path); periodic eval runs in the
+    background thread and still lands its JSONL records."""
     cfg = DDPGConfig(
         backend="jax_tpu",
         env_id="Pendulum-v1",
@@ -107,11 +108,53 @@ def test_train_jax_device_replay_path(tmp_path):
         replay_min_size=300,
         replay_capacity=20_000,
         prioritized=False,
+        eval_every=1_000,
+        eval_episodes=1,
         log_path=str(tmp_path / "metrics.jsonl"),
     )
     out = train_jax(cfg)
     assert out["learner_steps"] > 0
     assert np.isfinite(out["final_return"])
+    import json
+
+    kinds = [json.loads(l)["kind"] for l in open(cfg.log_path)]
+    assert "eval" in kinds, f"no background-eval record in {kinds}"
+    # Per-phase timing breakdown (SURVEY.md §5) rides in the train/final
+    # records (train cadence is 50 chunks; short runs still get the final).
+    recs = [json.loads(l) for l in open(cfg.log_path)]
+    assert any("t_dispatch_ms" in r for r in recs), recs
+
+
+def test_async_saver_snapshot_isolation(tmp_path):
+    """save_async must snapshot at call time: mutations made to the replay
+    AFTER save_async returns (but possibly before the background write
+    finishes) must not leak into the checkpoint. Also: while the writer is
+    busy, further saves coalesce (skip) instead of queueing."""
+    from distributed_ddpg_tpu.replay import UniformReplay
+
+    cfg = DDPGConfig(actor_hidden=(16, 16), critic_hidden=(16, 16))
+    state = init_train_state(cfg, 4, 2, seed=0)
+    replay = UniformReplay(50_000, 4, 2, seed=0)
+    rng = np.random.default_rng(0)
+    obs = rng.standard_normal((40_000, 4)).astype(np.float32)
+    replay.add_batch(
+        obs,
+        rng.standard_normal((40_000, 2)).astype(np.float32),
+        np.arange(40_000, dtype=np.float32),
+        np.full(40_000, 0.99, np.float32),
+        obs,
+    )
+    saver = ckpt_lib.AsyncSaver()
+    assert saver.save_async(str(tmp_path), 3, state, replay, cfg) is True
+    # Mutate immediately — the background write must not see this.
+    replay.reward[:40_000] = -1.0
+    saver.wait()
+    fresh = UniformReplay(50_000, 4, 2, seed=1)
+    _, step, _ = ckpt_lib.restore(str(tmp_path), init_train_state(cfg, 4, 2, seed=2), fresh)
+    assert step == 3 and len(fresh) == 40_000
+    np.testing.assert_array_equal(
+        fresh.reward[:40_000], np.arange(40_000, dtype=np.float32)
+    )
 
 
 def test_checkpoint_roundtrip_device_replay(tmp_path):
