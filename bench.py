@@ -42,12 +42,11 @@ import numpy as np
 OBS_DIM, ACT_DIM = 17, 6
 HIDDEN = (256, 256)
 BATCH = 64
-CHUNK = 800          # learner steps per dispatch (lax.scan). With the chunk's
-                     # batches pre-gathered up front and scan unroll=4
-                     # (parallel/learner.py), v5e-1 measures 200 -> 49.7k,
-                     # 800 -> 89.5k, 3200 -> 91.0k steps/s; 800 keeps the
-                     # dispatch under ~9 ms so actor ingest between chunks
-                     # stays timely
+CHUNK = 800          # learner steps per dispatch (lax.scan). Chosen from the
+                     # measured chunk sweep (see the latest BENCH_r*.json /
+                     # the "study" phase): rate saturates around 800 while
+                     # keeping the dispatch short enough that actor ingest
+                     # between chunks stays timely
 NATIVE_STEPS = 400
 
 # Peak bf16/f32 matmul throughput per chip, for the MFU estimate. Keyed by
@@ -209,6 +208,12 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=CHUNK) -> dict
         "device_kind": dev.device_kind,
         "n_devices": n_dev,
         "per_device_rate": rate / n_dev,
+        "fused_chunk_active": learner.fused_chunk_active,
+        **(
+            {"fused_chunk_error": learner.fused_chunk_error}
+            if learner.fused_chunk_error
+            else {}
+        ),
         # Per-phase breakdown (SURVEY.md §5): mean chunk dispatch(+compute
         # backpressure) time vs actor-ingest h2d time per loop iteration.
         "t_dispatch_ms": round(1000.0 * t_dispatch / max(dispatches, 1), 3),
@@ -238,12 +243,40 @@ def phase_probe() -> dict:
 
 def phase_jax() -> dict:
     """Accelerator (or JAX_PLATFORMS-forced) measurement over the FULL local
-    mesh (config data_axis=-1: all attached devices data-parallel)."""
+    mesh (config data_axis=-1: all attached devices data-parallel).
+
+    Intra-phase degradation (VERDICT.md round-2 Weak #2): a failure of the
+    default (fused_chunk='auto') path must not discard a healthy backend —
+    retry once with the megakernel hard-disabled before giving up, and
+    record what broke."""
     _assert_platform()
     seconds = float(os.environ.get("BENCH_SECONDS", "20"))
     config = _config()
+    if os.environ.get("BENCH_FUSED", "") == "off":
+        config = config.replace(fused_chunk="off")
     replay = _fill_replay(config)
-    return _measure_jax(config, replay, seconds)
+    try:
+        return _measure_jax(config, replay, seconds)
+    except Exception as e:
+        # Only a single-device mesh on a kernel-native backend can have had
+        # the megakernel active (parallel/learner.py activation conditions +
+        # fused_chunk.runs_native) — elsewhere a fused-off rerun is a
+        # guaranteed-identical failure, so don't waste the time.
+        import jax
+
+        from distributed_ddpg_tpu.ops.fused_chunk import runs_native
+
+        if (
+            config.fused_chunk == "off"
+            or len(jax.devices()) != 1
+            or not runs_native()
+        ):
+            raise
+        result = _measure_jax(
+            config.replace(fused_chunk="off"), replay, seconds
+        )
+        result["fused_chunk_error"] = repr(e)[:800]
+        return result
 
 
 def phase_scaling() -> dict:
@@ -287,6 +320,10 @@ def _run_phase(name: str, env_overrides: dict, timeout: float):
     (None, error_string). Subprocess isolation means a wedged accelerator
     runtime is bounded by `timeout` instead of hanging the harness."""
     env = dict(os.environ)
+    # Unfiltered tracebacks so a captured phase error names the actual
+    # failing op/spec instead of JAX's "internal frames removed" stub
+    # (ADVICE.md round 2).
+    env.setdefault("JAX_TRACEBACK_FILTERING", "off")
     env.update({k: str(v) for k, v in env_overrides.items()})
     try:
         proc = subprocess.run(
@@ -354,6 +391,21 @@ def main() -> int:
         accel, err = _run_phase("jax", accel_env, timeout=900)
         if not accel:
             errors.append(err)
+            # Second line of defense: the phase-internal retry handles
+            # kernel failures, but if the whole phase died (e.g. a crash
+            # that took the subprocess down), try once more with the
+            # megakernel hard-disabled before abandoning the accelerator —
+            # but only where the kernel could have been active at all
+            # (single accelerator device; multi-device meshes and CPU never
+            # activate it, so the rerun would fail identically).
+            if probe.get("n_devices") == 1 and probe.get("platform") in (
+                "tpu", "axon"
+            ):
+                accel, err = _run_phase(
+                    "jax", {**accel_env, "BENCH_FUSED": "off"}, timeout=900
+                )
+                if not accel:
+                    errors.append(err)
     if accel is None and forced != "cpu":
         # Accelerator dead: fall back to JAX-on-CPU so the harness still
         # reports an end-to-end jax-path number, clearly labeled. (forced
@@ -372,7 +424,8 @@ def main() -> int:
         result["device_kind"] = accel["device_kind"]
         result["n_devices"] = accel["n_devices"]
         result["per_device_rate"] = round(accel["per_device_rate"], 1)
-        for key in ("t_dispatch_ms", "t_ingest_ms"):
+        for key in ("t_dispatch_ms", "t_ingest_ms", "fused_chunk_error",
+                    "fused_chunk_active"):
             if key in accel:
                 result[key] = accel[key]
         if "mfu" in accel:

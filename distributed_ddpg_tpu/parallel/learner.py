@@ -220,6 +220,7 @@ class ShardedLearner:
                 "hidden layers, and nets small enough for VMEM "
                 "(ops/fused_chunk.fits_vmem)"
             )
+        scan_sample_chunk_fn = sample_chunk_fn
         if self.fused_chunk_active:
             run_fused = fused_chunk_lib.make_fused_chunk_fn(
                 config, obs_dim, act_dim, action_scale, action_offset,
@@ -282,19 +283,37 @@ class ShardedLearner:
             ),
             donate_argnums=(0, 1, 4),
         )
-        self._sample_chunk_step = jax.jit(
-            sample_chunk_fn,
-            in_shardings=(self._state_sharding, replicated, storage_sharding, replicated),
-            out_shardings=(
-                StepOutput(
-                    state=self._state_sharding,
-                    td_errors=td_chunk_sharding,
-                    metrics={k: replicated for k in METRIC_KEYS},
+        def _jit_sample_chunk(fn):
+            return jax.jit(
+                fn,
+                in_shardings=(
+                    self._state_sharding, replicated, storage_sharding, replicated
                 ),
-                replicated,
-            ),
-            donate_argnums=(0, 1),
+                out_shardings=(
+                    StepOutput(
+                        state=self._state_sharding,
+                        td_errors=td_chunk_sharding,
+                        metrics={k: replicated for k in METRIC_KEYS},
+                    ),
+                    replicated,
+                ),
+                donate_argnums=(0, 1),
+            )
+
+        # jax.jit is lazy, so holding BOTH paths costs nothing until called:
+        # the scan jit is the first-dispatch fallback target if the
+        # megakernel fails to compile on this backend (VERDICT.md round-2
+        # Weak #2 — a Mosaic failure must degrade, not kill the caller; the
+        # failure can only surface at compile, i.e. first dispatch, so no
+        # extra probe compile is paid on healthy backends).
+        self._scan_sample_chunk_step = _jit_sample_chunk(scan_sample_chunk_fn)
+        self._sample_chunk_step = (
+            _jit_sample_chunk(sample_chunk_fn)
+            if self.fused_chunk_active
+            else self._scan_sample_chunk_step
         )
+        self._sample_chunk_compiled = False
+        self.fused_chunk_error: Optional[str] = None
         self._key = jax.device_put(jax.random.PRNGKey(config.seed), replicated)
 
     # --- single step ---
@@ -330,9 +349,44 @@ class ShardedLearner:
 
     def run_sample_chunk(self, device_replay) -> StepOutput:
         """K learner steps sampling uniformly from a DeviceReplay — the
-        zero-h2d steady-state path (batches never touch the host)."""
+        zero-h2d steady-state path (batches never touch the host).
+
+        In fused_chunk='auto' mode a megakernel COMPILE failure on the
+        first dispatch degrades to the XLA scan path; 'on' lets the error
+        propagate for tests/explicit opt-in. The fallback is confined to
+        the first dispatch and to intact inputs: donation consumes buffers
+        at invoke (not on success), so a post-compile execution failure
+        must re-raise rather than retry against deleted arrays."""
         storage, size = device_replay.device_state()
-        out, self._key = self._sample_chunk_step(self.state, self._key, storage, size)
+        try:
+            out, self._key = self._sample_chunk_step(
+                self.state, self._key, storage, size
+            )
+        except Exception as e:
+            retryable = (
+                self.fused_chunk_active
+                and self.config.fused_chunk == "auto"
+                and not self._sample_chunk_compiled
+                and not any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree.leaves((self.state, self._key))
+                )
+            )
+            if not retryable:
+                raise
+            import warnings
+
+            warnings.warn(
+                "fused_chunk='auto': megakernel failed on this backend; "
+                f"falling back to the XLA scan path: {e!r}"
+            )
+            self.fused_chunk_error = repr(e)[:800]
+            self.fused_chunk_active = False
+            self._sample_chunk_step = self._scan_sample_chunk_step
+            out, self._key = self._sample_chunk_step(
+                self.state, self._key, storage, size
+            )
+        self._sample_chunk_compiled = True
         self.state = out.state
         return out
 

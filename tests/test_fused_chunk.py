@@ -138,6 +138,45 @@ def test_sharded_learner_fused_path_matches_scan_path():
         )
 
 
+def test_auto_mode_falls_back_on_kernel_failure(monkeypatch):
+    """fused_chunk='auto': a megakernel that dies at first dispatch (the
+    round-2 Mosaic BlockSpec failure mode) must degrade to the XLA scan
+    path with a warning — and keep training — instead of raising."""
+    from distributed_ddpg_tpu.ops import fused_chunk as fc
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.mesh import make_mesh
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+
+    monkeypatch.setattr(fc, "runs_native", lambda: True)
+
+    def broken_make(*args, **kwargs):
+        def run(state, batches):
+            raise RuntimeError("mosaic boom")
+
+        return run
+
+    monkeypatch.setattr(fc, "make_fused_chunk_fn", broken_make)
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B,
+        fused_chunk="auto",
+    )
+    lrn = ShardedLearner(
+        cfg, OBS, ACT, action_scale=1.0,
+        mesh=make_mesh(1, 1, devices=jax.devices()[:1]), chunk_size=K,
+    )
+    assert lrn.fused_chunk_active
+    rep = DeviceReplay(
+        capacity=64, obs_dim=OBS, act_dim=ACT, mesh=lrn.mesh, block_size=64
+    )
+    rep.add_packed(_batches(np.random.default_rng(3), 4).reshape(-1, rep.width))
+    with pytest.warns(UserWarning, match="falling back"):
+        out = lrn.run_sample_chunk(rep)
+    assert not lrn.fused_chunk_active
+    assert np.isfinite(float(out.metrics["critic_loss"]))
+    out2 = lrn.run_sample_chunk(rep)  # steady state keeps working
+    assert np.isfinite(float(out2.metrics["critic_loss"]))
+
+
 def test_fused_chunk_on_requires_envelope():
     from distributed_ddpg_tpu.parallel.learner import ShardedLearner
     from distributed_ddpg_tpu.parallel.mesh import make_mesh
