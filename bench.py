@@ -42,11 +42,6 @@ import numpy as np
 OBS_DIM, ACT_DIM = 17, 6
 HIDDEN = (256, 256)
 BATCH = 64
-CHUNK = 800          # learner steps per dispatch (lax.scan). Chosen from the
-                     # measured chunk sweep (see the latest BENCH_r*.json /
-                     # the "study" phase): rate saturates around 800 while
-                     # keeping the dispatch short enough that actor ingest
-                     # between chunks stays timely
 NATIVE_STEPS = 400
 
 # Peak bf16/f32 matmul throughput per chip, for the MFU estimate. Keyed by
@@ -148,17 +143,26 @@ def phase_native() -> dict:
     return {"native_rate": rate}
 
 
-def _measure_jax(config, replay, seconds: float, mesh=None, chunk=CHUNK) -> dict:
+def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     """Steady-state learner rate on the device-resident replay path
     (replay/device.py): sampling is fused into the scanned chunk, and the
     only h2d traffic is the actor ingest stream, modeled at the 16-actor
-    MuJoCo rate (~8k transitions/sec) and INCLUDED in the measured loop."""
+    MuJoCo rate (~8k transitions/sec) and INCLUDED in the measured loop.
+
+    chunk=None measures the PRODUCTION steps-per-dispatch — the same
+    resolve_learner_chunk value train_jax runs — so the headline number and
+    the trainer are the same program (VERDICT.md round-2 Weak #3)."""
     import jax
 
-    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.learner import (
+        ShardedLearner,
+        resolve_learner_chunk,
+    )
     from distributed_ddpg_tpu.replay.device import DeviceReplay
     from distributed_ddpg_tpu.types import pack_batch_np
 
+    if chunk is None:
+        chunk = resolve_learner_chunk(config)
     learner = ShardedLearner(
         config, OBS_DIM, ACT_DIM, action_scale=1.0, chunk_size=chunk, mesh=mesh
     )
@@ -208,6 +212,7 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=CHUNK) -> dict
         "device_kind": dev.device_kind,
         "n_devices": n_dev,
         "per_device_rate": rate / n_dev,
+        "chunk": chunk,
         "fused_chunk_active": learner.fused_chunk_active,
         **(
             {"fused_chunk_error": learner.fused_chunk_error}
@@ -424,8 +429,8 @@ def main() -> int:
         result["device_kind"] = accel["device_kind"]
         result["n_devices"] = accel["n_devices"]
         result["per_device_rate"] = round(accel["per_device_rate"], 1)
-        for key in ("t_dispatch_ms", "t_ingest_ms", "fused_chunk_error",
-                    "fused_chunk_active"):
+        for key in ("t_dispatch_ms", "t_ingest_ms", "chunk",
+                    "fused_chunk_error", "fused_chunk_active"):
             if key in accel:
                 result[key] = accel[key]
         if "mfu" in accel:

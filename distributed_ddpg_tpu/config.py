@@ -83,8 +83,26 @@ class DDPGConfig:
     # learner the rings/queues fill and workers block, throttling the env
     # stepping itself. 0 = free-running async (the reference's semantics).
     max_ingest_ratio: float = 0.0
+    # Learner-rate cap (the converse of max_ingest_ratio, and the knob the
+    # equal-return quality gate turns): learner steps <= replay_min_size +
+    # ratio * env steps. The reference's sync semantics are ratio = 1/
+    # train_every; 0 = free-running async (learner as fast as the TPU goes).
+    max_learn_ratio: float = 0.0
     param_refresh_every: int = 1     # learner steps between actor param refresh
+    # Wall-clock floor between actor param broadcasts in train_jax. A
+    # broadcast must sync the in-flight chunk and round-trip params
+    # device->host, which costs ~chunk-compute x20 on a tunneled TPU; the
+    # floor bounds that overhead to a fixed fraction of wall time while
+    # param_refresh_every keeps the learner-step semantics.
+    param_refresh_interval_s: float = 0.1
     prefetch_depth: int = 2          # host->HBM double-buffer depth
+    # Learner steps per dispatch (lax.scan / megakernel chunk length) in
+    # train_jax. 0 = auto: 800 on kernel-native TPU backends (measured —
+    # the rate saturates around 800 while one dispatch stays ~4 ms, see
+    # BENCH_r*.json), 8 elsewhere (CPU dev/test dispatches stay snappy).
+    # Ingest, param refresh, and the env-step budget check all run once per
+    # chunk, so the chunk also bounds ingest latency and budget overshoot.
+    learner_chunk: int = 0
 
     # --- precision ---
     compute_dtype: str = "float32"   # bit-comparability oracle needs f32
@@ -162,6 +180,19 @@ class DDPGConfig:
             )
         if self.max_ingest_ratio < 0:
             raise ValueError("max_ingest_ratio must be >= 0 (0 = unlimited)")
+        if self.learner_chunk < 0:
+            raise ValueError("learner_chunk must be >= 0 (0 = auto)")
+        if self.max_learn_ratio < 0:
+            raise ValueError("max_learn_ratio must be >= 0 (0 = unlimited)")
+        if self.max_learn_ratio > 0 and self.max_ingest_ratio > 0:
+            raise ValueError(
+                "max_learn_ratio and max_ingest_ratio are mutually "
+                "exclusive: capping the learner against env steps while "
+                "also capping ingest against learner steps can freeze both "
+                "counters (each waits on the other) and livelock the loop"
+            )
+        if self.param_refresh_interval_s < 0:
+            raise ValueError("param_refresh_interval_s must be >= 0")
         if self.transport not in ("auto", "shm", "queue"):
             raise ValueError(
                 f"transport must be 'auto', 'shm', or 'queue', got "

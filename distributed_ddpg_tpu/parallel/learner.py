@@ -46,6 +46,21 @@ from distributed_ddpg_tpu.types import (
     unpack_batch,
 )
 
+def resolve_learner_chunk(config: DDPGConfig) -> int:
+    """Production learner steps-per-dispatch: config.learner_chunk when set,
+    else measured defaults — 800 on kernel-native TPU backends (the rate
+    saturates around chunk 800 while one dispatch stays ~4 ms; see the
+    latest BENCH_r*.json chunk sweep), 8 elsewhere (CPU scan dispatches in
+    dev/test stay snappy). train_jax and bench.py both resolve through
+    here so the trainer and the benchmark run the same program
+    (VERDICT.md round-2 Weak #3)."""
+    if config.learner_chunk > 0:
+        return config.learner_chunk
+    from distributed_ddpg_tpu.ops.fused_chunk import runs_native
+
+    return 800 if runs_native() else 8
+
+
 class ShardedLearner:
     def __init__(
         self,

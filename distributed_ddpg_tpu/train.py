@@ -260,7 +260,10 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
     from distributed_ddpg_tpu.actors.pool import ActorPool
     from distributed_ddpg_tpu.parallel import multihost
-    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.learner import (
+        ShardedLearner,
+        resolve_learner_chunk,
+    )
     from distributed_ddpg_tpu.parallel.prefetch import ChunkPrefetcher
 
     from distributed_ddpg_tpu.replay.device import (
@@ -272,7 +275,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     is_multi = multihost.initialize()
     env = make(config.env_id, seed=config.seed)
     spec = spec_of(env)
-    chunk = 8  # learner steps per dispatch (lax.scan)
+    chunk = resolve_learner_chunk(config)
     learner = ShardedLearner(
         config,
         spec.obs_dim,
@@ -419,6 +422,19 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         with replay_lock:
             return pool.drain_into(replay, max_rows=max_rows)
 
+    def ingest_once(force_ship: bool = False) -> int:
+        """One ingest beat: drain actor transports (timed), then — multi-host
+        only — the UNCONDITIONAL lockstep sync_ship collective. Every site
+        that ingests on the hot path must go through here: the drain gate
+        uses process-LOCAL counters, so the collective must not be skippable
+        on some processes (replay/device.py sync_ship)."""
+        with phases.phase("ingest"):
+            moved = drain()
+            env_timer.tick(moved)
+        if use_device_replay and is_multi:
+            device_replay.sync_ship(force=force_ship)
+        return moved
+
     def buffer_fill() -> int:
         return len(device_replay) if use_device_replay else len(replay)
 
@@ -442,20 +458,15 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
 
     next_refresh = 0
     last_eval = 0
+    last_refresh_t = 0.0
+    last_log_t = 0.0
 
     def after_chunk(out, indices) -> None:
         nonlocal learn_steps, last_ckpt, next_refresh, last_eval
+        nonlocal last_refresh_t, last_log_t
         learn_steps += chunk
         learn_timer.tick(chunk)
-        with phases.phase("ingest"):
-            env_timer.tick(drain())
-        if use_device_replay and is_multi:
-            # Lockstep multi-host ingest (replay/device.py sync_ship): every
-            # process executes the identical global inserts here, once per
-            # chunk — local add_packed only buffered. Unconditional: the
-            # ingest gate above is computed from process-LOCAL counters, so
-            # it cannot be allowed to skip a collective on some processes.
-            device_replay.sync_ship()
+        ingest_once()
 
         if config.prioritized and not use_device_replay:
             # Host PER: priorities live in the CPU sum-tree; the device path
@@ -464,12 +475,23 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                 _host_per_update(out, indices)
 
         # param_refresh_every is in LEARNER STEPS (config.py); refresh on
-        # every crossing of a multiple (chunks advance 8 steps at a time).
-        if learn_steps >= next_refresh:
-            pool.broadcast(learner.actor_params_to_host(), learn_steps)
+        # every crossing of a multiple (chunks advance `chunk` steps at a
+        # time). The wall-clock floor (param_refresh_interval_s) bounds the
+        # refresh's pipeline-sync + d2h cost to a fixed fraction of wall
+        # time — without it a per-chunk broadcast serializes the device
+        # pipeline (each one waits out the in-flight chunk).
+        now = time.perf_counter()
+        if (
+            learn_steps >= next_refresh
+            and now - last_refresh_t >= config.param_refresh_interval_s
+        ):
+            with phases.phase("refresh"):
+                pool.broadcast(learner.actor_params_to_host(), learn_steps)
             next_refresh = learn_steps + config.param_refresh_every
+            last_refresh_t = time.perf_counter()
 
-        if learn_steps % (50 * chunk) == 0:
+        if learn_steps % (50 * chunk) == 0 and now - last_log_t >= 1.0:
+            last_log_t = now
             pool.monitor()
             episodes = pool.episode_stats()
             mean_ret = (
@@ -528,22 +550,21 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         min_fill = max(config.replay_min_size, config.batch_size)
         warm_it = 0
         while buffer_fill() < min_fill:
-            moved = drain()
-            env_timer.tick(moved)
+            # Lockstep warmup ingest: loop count is driven by the
+            # globally-replicated buffer size and `warm_it` advances
+            # identically everywhere, so every process calls sync_ship
+            # (inside ingest_once) the same number of times. Periodic
+            # force pads a block from sub-block trickles so slow actors
+            # still cross the threshold.
+            moved = ingest_once(force_ship=(warm_it % 20 == 19))
             pool.monitor()
-            if use_device_replay:
-                if is_multi:
-                    # Lockstep warmup ingest: loop count is driven by the
-                    # globally-replicated buffer size and `warm_it` advances
-                    # identically everywhere, so every process calls
-                    # sync_ship (a collective) the same number of times.
-                    # Periodic force pads a block from sub-block trickles so
-                    # slow actors still cross the threshold.
-                    device_replay.sync_ship(force=(warm_it % 20 == 19))
-                elif moved and buffer_fill() + len(
-                    device_replay._pending
-                ) >= min_fill:
-                    device_replay.flush()
+            if (
+                use_device_replay
+                and not is_multi
+                and moved
+                and buffer_fill() + len(device_replay._pending) >= min_fill
+            ):
+                device_replay.flush()
             if not moved:
                 time.sleep(0.05)
             warm_it += 1
@@ -577,6 +598,22 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
                     budget_now = env_steps()
                 if budget_now >= config.total_env_steps:
                     break
+                if config.max_learn_ratio > 0.0 and (
+                    learn_steps + chunk
+                    > max(config.replay_min_size, config.batch_size)
+                    + config.max_learn_ratio * budget_now
+                ):
+                    # Learner-rate cap (config.max_learn_ratio): ahead of
+                    # the allowance — ingest instead of dispatching until
+                    # env steps catch up. The decision uses budget_now,
+                    # which is globally agreed on multi-host, so every
+                    # process skips the same iterations and the SPMD
+                    # collective schedule stays aligned (same reasoning as
+                    # the loop-exit condition above).
+                    if not ingest_once():
+                        time.sleep(0.002)
+                    it += 1
+                    continue
                 if use_device_replay:
                     if config.prioritized:
                         # beta anneal rides in as a scalar arg. It must be
