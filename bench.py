@@ -213,6 +213,7 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
         "n_devices": n_dev,
         "per_device_rate": rate / n_dev,
         "chunk": chunk,
+        "global_batch": learner.global_batch,
         "fused_chunk_active": learner.fused_chunk_active,
         **(
             {"fused_chunk_error": learner.fused_chunk_error}
@@ -226,8 +227,10 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     }
     peak = _peak_flops(dev.device_kind)
     if peak is not None:
+        # FLOPs per grad step scale with the GLOBAL batch (per-device draws
+        # under scale_batch_with_data), not the config batch.
         result["mfu"] = rate * flops_per_grad_step(
-            OBS_DIM, ACT_DIM, HIDDEN, BATCH
+            OBS_DIM, ACT_DIM, HIDDEN, learner.global_batch
         ) / (peak * n_dev)
     return result
 
@@ -285,27 +288,45 @@ def phase_jax() -> dict:
 
 
 def phase_scaling() -> dict:
-    """Data-parallel scaling curve on N virtual CPU devices (the multi-chip
-    stand-in this 1-chip environment allows; VERDICT.md Missing #5). The
-    orchestrator sets xla_force_host_platform_device_count=8. Absolute CPU
-    rates are meaningless — the curve's SHAPE (collective + sharding
-    overhead vs data_axis size) is the signal."""
+    """Data-parallel scaling curves on N virtual CPU devices (the multi-chip
+    stand-in this 1-chip environment allows). The orchestrator sets
+    xla_force_host_platform_device_count=8. Absolute CPU rates are
+    meaningless — the curves' SHAPE is the signal. Two curves
+    (VERDICT.md round-2 Missing #4 / Weak #7):
+
+      scaled_batch (production default): batch_size is per-device, global
+        batch grows with the mesh — aggregate row throughput must grow.
+      fixed_global_batch: round-2 semantics (64 rows sliced across N
+        devices) — kept to show WHY it regresses (collective latency per
+        ever-smaller shard), with the per-phase breakdown to prove it.
+    """
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     from distributed_ddpg_tpu.parallel import mesh as mesh_lib
 
     seconds = float(os.environ.get("BENCH_SECONDS", "3"))
-    config = _config().replace(fused_chunk="off")
-    replay = _fill_replay(config, n=40_000)
-    curve = {}
-    for n in (1, 2, 4, 8):
-        if n > len(jax.devices()):
-            break
-        mesh = mesh_lib.make_mesh(data_axis=n, devices=jax.devices()[:n])
-        r = _measure_jax(config, replay, seconds, mesh=mesh, chunk=100)
-        curve[str(n)] = round(r["rate"], 1)
-    return {"scaling_cpu_virtual": curve}
+    replay = _fill_replay(_config(), n=40_000)
+    curves = {}
+    for label, scaled in (("scaled_batch", True), ("fixed_global_batch", False)):
+        config = _config().replace(
+            fused_chunk="off", scale_batch_with_data=scaled
+        )
+        curve = {}
+        for n in (1, 2, 4, 8):
+            if n > len(jax.devices()):
+                break
+            mesh = mesh_lib.make_mesh(data_axis=n, devices=jax.devices()[:n])
+            r = _measure_jax(config, replay, seconds, mesh=mesh, chunk=100)
+            curve[str(n)] = {
+                "grad_steps_per_sec": round(r["rate"], 1),
+                "global_batch": r["global_batch"],
+                "rows_per_sec": round(r["rate"] * r["global_batch"], 1),
+                "t_dispatch_ms": r["t_dispatch_ms"],
+                "t_ingest_ms": r["t_ingest_ms"],
+            }
+        curves[label] = curve
+    return {"scaling_cpu_virtual": curves}
 
 
 _PHASES = {

@@ -76,6 +76,14 @@ class DDPGConfig:
     backend: str = "jax_tpu"
     data_axis: int = -1              # -1: all devices on data axis
     model_axis: int = 1              # tensor-parallel degree over hidden dims
+    # Data-parallel batch semantics for the device-sampling learner paths:
+    # True (default) = batch_size is PER-DEVICE — each data-axis device
+    # draws its own batch_size rows and the global batch grows with the
+    # mesh (grads merge via the sharding-induced AllReduce), so adding
+    # chips adds throughput. False = batch_size is the GLOBAL batch sharded
+    # ever thinner across devices (round-2 semantics, kept for fixed-batch
+    # scaling studies; collective latency swamps compute past ~2 devices).
+    scale_batch_with_data: bool = True
     train_every: int = 1             # env steps between learner steps (sync mode)
     # Async ingest rate limiter (the staleness-control knob SURVEY.md §7
     # 'hard parts (b)' calls for): cap drained env steps at

@@ -106,7 +106,16 @@ class OnDeviceDDPG:
             config.data_axis, config.model_axis
         )
         data_size = self.mesh.shape["data"]
-        if config.batch_size % data_size:
+        # Same per-device batch semantics as the sharded learner
+        # (parallel/learner.py global_batch): scale_batch_with_data draws
+        # batch_size rows per data-axis device, so throughput grows with
+        # the mesh instead of slicing a fixed batch thinner.
+        self.global_batch = (
+            config.batch_size * data_size
+            if config.scale_batch_with_data
+            else config.batch_size
+        )
+        if self.global_batch % data_size:
             raise ValueError(
                 f"batch_size={config.batch_size} not divisible by data axis "
                 f"size {data_size}"
@@ -190,10 +199,12 @@ class OnDeviceDDPG:
 
         zero_metrics = {k: jnp.zeros((), jnp.float32) for k in METRIC_KEYS}
 
+        global_batch = self.global_batch
+
         def learn_step(carry: Carry):
             key, k_sample = jax.random.split(carry.key)
             idx = jax.random.randint(
-                k_sample, (cfg.batch_size,), 0, jnp.maximum(carry.size, 1)
+                k_sample, (global_batch,), 0, jnp.maximum(carry.size, 1)
             )
             packed = jax.lax.with_sharding_constraint(
                 carry.storage[idx], NamedSharding(self.mesh, P("data", None))

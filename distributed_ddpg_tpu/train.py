@@ -572,7 +572,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         prefetch = None
         if not use_device_replay:
             prefetch = ChunkPrefetcher(
-                replay, learner.put_chunk, config.batch_size, chunk,
+                replay, learner.put_chunk, learner.global_batch, chunk,
                 depth=config.prefetch_depth, lock=replay_lock,
             ).start()
 

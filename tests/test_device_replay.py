@@ -66,7 +66,10 @@ def test_fused_sampling_chunk():
     )
     rep.add_packed(_rows(np.random.default_rng(2), 512))
     out = lrn.run_sample_chunk(rep)
-    assert np.asarray(out.td_errors).shape == (4, B)
+    # Default scale_batch_with_data: B rows per data-axis device (8 fake
+    # devices in the test mesh -> global batch 8B).
+    assert np.asarray(out.td_errors).shape == (4, lrn.global_batch)
+    assert lrn.global_batch == 8 * B
     assert np.isfinite(float(out.metrics["critic_loss"]))
     assert int(jax.device_get(lrn.state.step)) == 4
     # Keys advance: two chunks give different losses (different samples).
@@ -89,13 +92,16 @@ def test_sample_chunk_matches_manual_steps():
     )
     rep.add_packed(_rows(np.random.default_rng(4), 512))
 
-    # Reproduce the indices sample_chunk_fn will draw from lrn._key.
+    # Reproduce the indices sample_chunk_fn will draw from lrn._key
+    # (global_batch rows per step: B per data-axis device).
     key = jax.device_get(lrn._key)
     _, sub = jax.random.split(key)
-    idx = np.asarray(jax.random.randint(sub, (K, B), 0, len(rep)))
+    idx = np.asarray(
+        jax.random.randint(sub, (K, lrn.global_batch), 0, len(rep))
+    )
 
     out = lrn.run_sample_chunk(rep)
-    assert np.asarray(out.td_errors).shape == (K, B)
+    assert np.asarray(out.td_errors).shape == (K, lrn.global_batch)
 
     storage = np.asarray(jax.device_get(rep.storage))
     from distributed_ddpg_tpu.types import unpack_batch

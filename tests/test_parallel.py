@@ -169,3 +169,35 @@ def test_prefetcher_surfaces_worker_exception_promptly():
         pf.next(timeout=30)
     assert _time.time() - t0 < 5, "exception should surface promptly, not on timeout"
     pf.stop()
+
+
+@pytest.mark.parametrize("scaled,want_batch", [(True, 4 * B), (False, B)])
+def test_scale_batch_with_data(scaled, want_batch):
+    """Per-device batch semantics (config.scale_batch_with_data): on a
+    4-device data mesh the sampling paths draw batch_size rows PER DEVICE
+    (global batch 4B), so adding chips adds throughput instead of slicing
+    a fixed 64 rows thinner; False preserves the fixed-global semantics."""
+    from distributed_ddpg_tpu.replay.device import (
+        DevicePrioritizedReplay,
+        DeviceReplay,
+    )
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    cfg = _cfg(scale_batch_with_data=scaled)
+    mesh = mesh_lib.make_mesh(4, 1, devices=jax.devices()[:4])
+    K = 3
+    lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, mesh=mesh, chunk_size=K)
+    assert lrn.global_batch == want_batch
+    rng = np.random.default_rng(0)
+    rows = pack_batch_np(_np_batch(rng, b=2048))
+    rep = DeviceReplay(4096, OBS, ACT, mesh=mesh, block_size=1024)
+    rep.add_packed(rows)
+    out = lrn.run_sample_chunk(rep)
+    assert out.td_errors.shape == (K, want_batch)
+    assert np.isfinite(float(out.metrics["critic_loss"]))
+
+    per = DevicePrioritizedReplay(4096, OBS, ACT, mesh=mesh, block_size=1024)
+    per.add_packed(rows)
+    out = lrn.run_sample_chunk_per(per, beta=0.5)
+    assert out.td_errors.shape == (K, want_batch)
+    assert np.isfinite(float(out.metrics["critic_loss"]))

@@ -75,7 +75,9 @@ def test_train_jax_max_learn_ratio_caps_learner(tmp_path):
     # Overshoot is bounded by one chunk past the cap at the final env-step
     # count (env steps keep arriving while the last chunks dispatch, so use
     # the generous bound: budget + one chunk).
-    chunk = 8  # CPU auto default (resolve_learner_chunk)
+    from distributed_ddpg_tpu.parallel.learner import resolve_learner_chunk
+
+    chunk = resolve_learner_chunk(cfg)
     assert out["learner_steps"] > 0
     assert out["learner_steps"] <= cfg.replay_min_size + cfg.total_env_steps * 1.1 + chunk
 
