@@ -1,0 +1,94 @@
+"""Shared fused-chunk vs XLA-scan parity check, used by BOTH tiers:
+
+- tests/test_fused_chunk.py runs it in pallas interpret mode with tight
+  tolerances (the bit-level oracle, no TPU needed), and
+- tests/tpu_child.py runs it natively compiled on a real TPU with
+  fp-noise tolerances (two different on-TPU programs accumulate in
+  different orders).
+
+One body, parameterized by (interpret, tolerances), so the two tiers can
+never drift apart semantically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ddpg_tpu.learner import init_train_state, make_learner_step
+from distributed_ddpg_tpu.ops import fused_chunk
+from distributed_ddpg_tpu.types import pack_batch_np, unpack_batch
+
+
+def make_packed_batches(rng, k: int, b: int, obs: int, act: int):
+    return pack_batch_np(
+        {
+            "obs": rng.standard_normal((k, b, obs)).astype(np.float32),
+            "action": rng.uniform(-1, 1, (k, b, act)).astype(np.float32),
+            "reward": rng.standard_normal((k, b)).astype(np.float32),
+            "discount": np.full((k, b), 0.99, np.float32),
+            "next_obs": rng.standard_normal((k, b, obs)).astype(np.float32),
+            "weight": rng.uniform(0.5, 1.0, (k, b)).astype(np.float32),
+        }
+    )
+
+
+def assert_fused_matches_scan(
+    cfg,
+    obs: int,
+    act: int,
+    k: int,
+    scale,
+    offset,
+    interpret: bool | None,
+    rtol: float,
+    atol: float,
+    metric_rtol: float | None = None,
+):
+    """Run the megakernel chunk and K sequential scan-path steps on the same
+    batches; assert end state, TD errors, and chunk-mean metrics agree.
+    Returns the kernel's metrics dict."""
+    state = init_train_state(cfg, obs, act, seed=cfg.seed)
+    packed = make_packed_batches(
+        np.random.default_rng(7), k, cfg.batch_size, obs, act
+    )
+    run = fused_chunk.make_fused_chunk_fn(
+        cfg, obs, act, scale, offset, chunk_size=k, interpret=interpret
+    )
+    new_state, td, metrics = jax.jit(run)(state, jnp.asarray(packed))
+
+    step = make_learner_step(cfg, scale, action_offset=offset)
+    ref = state
+    ref_tds, ref_ms = [], []
+    for i in range(k):
+        out = step(ref, unpack_batch(jnp.asarray(packed[i]), obs, act))
+        ref = out.state
+        ref_tds.append(np.asarray(out.td_errors))
+        ref_ms.append(out.metrics)
+
+    def close(a, b):
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+            ),
+            a,
+            b,
+        )
+
+    close(new_state.actor_params, ref.actor_params)
+    close(new_state.critic_params, ref.critic_params)
+    close(new_state.target_actor_params, ref.target_actor_params)
+    close(new_state.target_critic_params, ref.target_critic_params)
+    close(new_state.actor_opt.mu, ref.actor_opt.mu)
+    close(new_state.critic_opt.nu, ref.critic_opt.nu)
+    assert int(new_state.actor_opt.count) == k
+    assert int(new_state.step) == k
+    np.testing.assert_allclose(
+        np.asarray(td), np.stack(ref_tds), rtol=rtol, atol=atol
+    )
+    m_rtol = metric_rtol if metric_rtol is not None else rtol
+    for name in metrics:
+        want = float(np.mean([float(m[name]) for m in ref_ms]))
+        np.testing.assert_allclose(
+            float(metrics[name]), want, rtol=m_rtol, atol=atol
+        )
+    return metrics

@@ -3,14 +3,12 @@ the XLA scan path (learner.make_learner_step applied K times) on identical
 batches — same params, targets, Adam moments, TD errors, and metrics."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from distributed_ddpg_tpu.config import DDPGConfig
-from distributed_ddpg_tpu.learner import init_train_state, make_learner_step
 from distributed_ddpg_tpu.ops import fused_chunk
-from distributed_ddpg_tpu.types import pack_batch_np, unpack_batch
+from distributed_ddpg_tpu.types import pack_batch_np
 
 OBS, ACT, B, K = 5, 3, 16, 4
 
@@ -46,45 +44,19 @@ def _assert_tree_close(a, b, rtol=2e-5, atol=1e-6):
     ],
 )
 def test_fused_chunk_matches_scan(hidden, scale, offset):
+    """Interpret-mode parity at tight tolerances — the bit-level oracle.
+    The same body runs natively compiled on real TPU via tests/tpu_child.py
+    (fused_parity_util.assert_fused_matches_scan)."""
+    from fused_parity_util import assert_fused_matches_scan
+
     cfg = DDPGConfig(
         actor_hidden=hidden, critic_hidden=hidden, batch_size=B, seed=3
     )
     assert fused_chunk.supported(cfg)
-    state = init_train_state(cfg, OBS, ACT, seed=3)
-    packed = _batches(np.random.default_rng(7), K)
-
-    # Reference: K sequential XLA steps.
-    step = make_learner_step(cfg, scale, action_offset=offset)
-    ref = state
-    ref_tds, ref_metrics = [], []
-    for k in range(K):
-        out = step(ref, unpack_batch(jnp.asarray(packed[k]), OBS, ACT))
-        ref = out.state
-        ref_tds.append(np.asarray(out.td_errors))
-        ref_metrics.append(out.metrics)
-
-    run = fused_chunk.make_fused_chunk_fn(
-        cfg, OBS, ACT, scale, offset, chunk_size=K, interpret=True
+    assert_fused_matches_scan(
+        cfg, OBS, ACT, K, scale, offset,
+        interpret=True, rtol=2e-5, atol=1e-6, metric_rtol=5e-5,
     )
-    new_state, td, metrics = jax.jit(run)(state, jnp.asarray(packed))
-
-    _assert_tree_close(new_state.actor_params, ref.actor_params)
-    _assert_tree_close(new_state.critic_params, ref.critic_params)
-    _assert_tree_close(new_state.target_actor_params, ref.target_actor_params)
-    _assert_tree_close(new_state.target_critic_params, ref.target_critic_params)
-    _assert_tree_close(new_state.actor_opt.mu, ref.actor_opt.mu)
-    _assert_tree_close(new_state.critic_opt.nu, ref.critic_opt.nu)
-    assert int(new_state.actor_opt.count) == K
-    assert int(new_state.step) == K
-
-    np.testing.assert_allclose(
-        np.asarray(td), np.stack(ref_tds), rtol=2e-5, atol=1e-6
-    )
-    for name in metrics:
-        want = float(np.mean([float(m[name]) for m in ref_metrics]))
-        np.testing.assert_allclose(
-            float(metrics[name]), want, rtol=5e-5, atol=1e-6
-        )
 
 
 def test_sharded_learner_fused_path_matches_scan_path():

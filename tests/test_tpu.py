@@ -1,0 +1,82 @@
+"""TPU-present test tier (VERDICT.md round-2 Missing #5 / Next #5): tests
+that compile NATIVELY on an attached TPU, auto-skipped when none is
+attached. Each case runs in a subprocess (tests/tpu_child.py) because
+conftest.py pins this process's JAX to the virtual CPU platform — the very
+pin that made the round-2 megakernel failure invisible to the suite.
+
+Run explicitly:  python -m pytest tests/test_tpu.py -m tpu -q
+(The default suite also collects these; they skip in seconds without TPU.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+CHILD = os.path.join(os.path.dirname(__file__), "tpu_child.py")
+
+
+def _run_child(case: str, timeout: float = 600) -> dict:
+    # Strip the parent suite's CPU pin, and surgically remove only the
+    # conftest-injected virtual-device token from XLA_FLAGS — any
+    # operator-supplied flags must reach the child unchanged.
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    if "XLA_FLAGS" in env:
+        kept = [
+            tok
+            for tok in env["XLA_FLAGS"].split()
+            if "xla_force_host_platform_device_count" not in tok
+        ]
+        if kept:
+            env["XLA_FLAGS"] = " ".join(kept)
+        else:
+            del env["XLA_FLAGS"]
+    env["JAX_TRACEBACK_FILTERING"] = "off"
+    proc = subprocess.run(
+        [sys.executable, CHILD, case],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-15:]
+        raise AssertionError(f"{case} child failed:\n" + "\n".join(tail))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"{case} child printed no JSON: {proc.stdout!r}")
+
+
+@pytest.fixture(scope="session")
+def tpu():
+    try:
+        probe = _run_child("probe", timeout=180)
+    except Exception as e:  # backend init failure == no usable TPU
+        pytest.skip(f"no native TPU backend: {e}")
+    if not probe.get("is_tpu"):
+        pytest.skip(f"no native TPU backend attached: {probe}")
+    return probe
+
+
+def test_fused_kernel_native_parity(tpu):
+    """The pallas megakernel must COMPILE under real Mosaic (not interpret
+    mode) and match the XLA scan path on the same chunk."""
+    out = _run_child("fused_parity")
+    assert out["ok"]
+
+
+def test_device_replay_ingest_and_sample_chunk(tpu):
+    """Real h2d DeviceReplay ingest + the production run_sample_chunk
+    dispatch; fused_chunk='auto' must actually activate on real TPU (if it
+    silently fell back, the flagship path is not being tested)."""
+    out = _run_child("sample_chunk")
+    assert out["ok"]
+    assert out["fused_chunk_active"], (
+        "megakernel did not activate on real TPU: "
+        f"{out.get('fused_chunk_error')}"
+    )
