@@ -137,7 +137,17 @@ class ActorPool:
             name=f"actor-{worker_id}",
         )
         p.start()
-        self._heartbeat[worker_id] = time.time()
+        # 0.0 = "never stamped": the worker is still booting (interpreter +
+        # gym/mujoco imports + env build — under N-process cold-start
+        # contention this takes many times the solo cost, easily past any
+        # fixed timeout). The silent-timeout respawn only arms once the
+        # worker's loop stamps its first real heartbeat; until then only
+        # the liveness check (real deaths) can respawn it. A worker that
+        # hangs FOREVER mid-boot while staying alive is therefore never
+        # respawned — accepted trade against the respawn stampede, which
+        # was self-sustaining (every respawn re-created the boot stampede
+        # that caused the timeout).
+        self._heartbeat[worker_id] = 0.0
         self._procs[worker_id] = p
 
     def start(self, actor_params) -> "ActorPool":
@@ -298,7 +308,13 @@ class ActorPool:
         respawned = 0
         for i, p in enumerate(self._procs):
             dead = p is None or not p.is_alive()
-            silent = now - self._heartbeat[i] > self.heartbeat_timeout
+            # heartbeat == 0 means the worker never finished booting (see
+            # _spawn) — the silent timeout is not armed yet; real deaths
+            # are caught by the liveness check above regardless.
+            silent = (
+                self._heartbeat[i] > 0.0
+                and now - self._heartbeat[i] > self.heartbeat_timeout
+            )
             if dead or silent:
                 if p is not None and p.is_alive():
                     p.terminate()

@@ -48,6 +48,12 @@ def run_worker(
     # Workers are CPU-only by construction; make BLAS behave in many procs.
     os.environ.setdefault("OMP_NUM_THREADS", "1")
 
+    # NOTE: no heartbeat stamp until the loop below — heartbeat 0.0 is the
+    # pool's "still booting" sentinel (ActorPool._spawn): under N-process
+    # cold-start contention the imports + env build here take many times
+    # the solo cost, and stamping mid-boot would arm the silent-timeout
+    # respawn before the worker can possibly meet it.
+
     from distributed_ddpg_tpu.actors.policy import NumpyPolicy, encode_version
     from distributed_ddpg_tpu.envs import make
     from distributed_ddpg_tpu.ops.noise import OUNoise

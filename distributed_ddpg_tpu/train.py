@@ -698,6 +698,15 @@ def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None
 
 
 def main(argv=None) -> None:
+    import faulthandler
+    import signal
+
+    # Stack dumps on demand (kill -USR1 <pid>) and on hard faults — a
+    # wedged driver must be debuggable without a debugger attached.
+    faulthandler.enable()
+    if hasattr(signal, "SIGUSR1"):
+        faulthandler.register(signal.SIGUSR1)
+
     from distributed_ddpg_tpu.platform_util import honor_jax_platforms
 
     honor_jax_platforms()
