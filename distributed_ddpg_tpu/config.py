@@ -192,12 +192,17 @@ class DDPGConfig:
             raise ValueError("learner_chunk must be >= 0 (0 = auto)")
         if self.max_learn_ratio < 0:
             raise ValueError("max_learn_ratio must be >= 0 (0 = unlimited)")
-        if self.max_learn_ratio > 0 and self.max_ingest_ratio > 0:
+        if (
+            self.max_learn_ratio > 0
+            and self.max_ingest_ratio > 0
+            and self.max_learn_ratio * self.max_ingest_ratio < 1.0
+        ):
             raise ValueError(
-                "max_learn_ratio and max_ingest_ratio are mutually "
-                "exclusive: capping the learner against env steps while "
-                "also capping ingest against learner steps can freeze both "
-                "counters (each waits on the other) and livelock the loop"
+                "max_learn_ratio * max_ingest_ratio < 1 livelocks: each "
+                "counter waits on the other and neither allowance can ever "
+                "open. With product >= 1 (e.g. both 1.0 — the equal-return "
+                "gate pinning ~1 grad step per env step from BOTH sides) "
+                "the two advance together at the slower side's pace."
             )
         if self.param_refresh_interval_s < 0:
             raise ValueError("param_refresh_interval_s must be >= 0")

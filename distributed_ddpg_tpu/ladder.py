@@ -32,6 +32,12 @@ from typing import Dict
 from distributed_ddpg_tpu.config import DDPGConfig
 
 _COMMON = dict(actor_hidden=(256, 256), critic_hidden=(256, 256))
+# The jax rungs pin ~1 grad step per env step from BOTH sides
+# (config.py: ratio product >= 1 is livelock-free): that is the
+# reference's sync replay ratio, which the equal-return gate compares
+# against. Free-running async (the throughput mode bench.py measures)
+# is a flag away: --max_learn_ratio=0 --max_ingest_ratio=0.
+_GATED = dict(max_learn_ratio=1.0, max_ingest_ratio=1.0, **_COMMON)
 
 RUNGS: Dict[int, DDPGConfig] = {
     1: DDPGConfig(
@@ -40,25 +46,29 @@ RUNGS: Dict[int, DDPGConfig] = {
     ),
     2: DDPGConfig(
         env_id="LunarLanderContinuous-v2", backend="jax_tpu", num_actors=4,
-        total_env_steps=300_000, **_COMMON,
+        total_env_steps=300_000, **_GATED,
     ),
     3: DDPGConfig(
         env_id="BipedalWalker-v3", backend="jax_tpu", num_actors=8,
-        prioritized=True, total_env_steps=1_000_000, **_COMMON,
+        prioritized=True, total_env_steps=1_000_000, **_GATED,
     ),
     4: DDPGConfig(
         env_id="HalfCheetah-v4", backend="jax_tpu", num_actors=16,
-        total_env_steps=1_000_000, **_COMMON,
+        total_env_steps=1_000_000, **_GATED,
     ),
     5: DDPGConfig(
         env_id="Humanoid-v4", backend="jax_tpu", num_actors=64,
-        total_env_steps=2_000_000, **_COMMON,
+        total_env_steps=2_000_000, **_GATED,
     ),
 }
 
 _SMOKE = dict(
     total_env_steps=3_000,
     replay_min_size=256,
+    # Small dispatches, explicitly: the TPU auto chunk (800) exceeds the
+    # gated rungs' initial allowance at replay_min 256 (train_jax's
+    # startup-livelock check would refuse to run).
+    learner_chunk=8,
     eval_every=3_000,
     eval_episodes=1,
     replay_capacity=50_000,
@@ -74,12 +84,19 @@ _SMOKE = dict(
 )
 
 
-def run(rung: int, smoke: bool = False) -> Dict[str, float]:
+def run(rung: int, smoke: bool = False, log_dir: str = "") -> Dict[str, float]:
     from distributed_ddpg_tpu.train import train
 
     config = RUNGS[rung]
     if smoke:
         config = config.replace(**_SMOKE)
+    if log_dir:
+        import os
+
+        os.makedirs(log_dir, exist_ok=True)
+        config = config.replace(
+            log_path=os.path.join(log_dir, f"rung{rung}_{config.env_id}.jsonl")
+        )
     summary = train(config)
     record = {
         "kind": "ladder",
@@ -103,9 +120,11 @@ def main(argv=None) -> None:
                    help="comma-separated rung numbers from BASELINE.md")
     p.add_argument("--smoke", action="store_true",
                    help="seconds-per-rung budgets (topology unchanged)")
+    p.add_argument("--log_dir", default="",
+                   help="write per-rung JSONL metrics under this directory")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
     for rung in (int(r) for r in args.rungs.split(",")):
-        run(rung, smoke=args.smoke)
+        run(rung, smoke=args.smoke, log_dir=args.log_dir)
 
 
 if __name__ == "__main__":

@@ -32,7 +32,20 @@ from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
 
 
+def _enable_faulthandler() -> None:
+    """Stack dumps on demand (kill -USR1 <pid>) and on hard faults — a
+    wedged driver must be debuggable without a debugger attached. Called
+    from train() so every driver entry (CLI, ladder, bench) gets it."""
+    import faulthandler
+    import signal
+
+    faulthandler.enable()
+    if hasattr(signal, "SIGUSR1"):
+        faulthandler.register(signal.SIGUSR1)
+
+
 def train(config: DDPGConfig) -> Dict[str, float]:
+    _enable_faulthandler()
     if config.backend == "native":
         return train_native(config)
     if config.backend == "jax_ondevice":
@@ -276,6 +289,25 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     env = make(config.env_id, seed=config.seed)
     spec = spec_of(env)
     chunk = resolve_learner_chunk(config)
+    min_fill = max(config.replay_min_size, config.batch_size)
+    if (
+        config.max_learn_ratio > 0.0
+        and config.max_ingest_ratio > 0.0
+        and chunk > (1.0 + config.max_learn_ratio) * min_fill
+    ):
+        # With BOTH gates armed the first chunk must fit the combined
+        # initial allowance: ingest caps env at W = max(replay_min, batch),
+        # so the learner gate (learn + chunk <= W + learn_ratio * env)
+        # needs chunk <= (1 + learn_ratio) * W — otherwise neither counter
+        # ever advances. (The config-level product >= 1 check can't see the
+        # resolved chunk, so the full condition lives here.)
+        raise ValueError(
+            f"learner chunk {chunk} exceeds the initial gate allowance "
+            f"(1 + max_learn_ratio) * {min_fill} = "
+            f"{(1.0 + config.max_learn_ratio) * min_fill:.0f}: the run "
+            "would livelock at startup. Lower learner_chunk or raise "
+            "replay_min_size."
+        )
     learner = ShardedLearner(
         config,
         spec.obs_dim,
@@ -546,8 +578,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             )
 
     try:
-        # --- warmup: fill replay to the learning threshold ---
-        min_fill = max(config.replay_min_size, config.batch_size)
+        # --- warmup: fill replay to the learning threshold (min_fill) ---
         warm_it = 0
         while buffer_fill() < min_fill:
             # Lockstep warmup ingest: loop count is driven by the
@@ -698,15 +729,6 @@ def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None
 
 
 def main(argv=None) -> None:
-    import faulthandler
-    import signal
-
-    # Stack dumps on demand (kill -USR1 <pid>) and on hard faults — a
-    # wedged driver must be debuggable without a debugger attached.
-    faulthandler.enable()
-    if hasattr(signal, "SIGUSR1"):
-        faulthandler.register(signal.SIGUSR1)
-
     from distributed_ddpg_tpu.platform_util import honor_jax_platforms
 
     honor_jax_platforms()

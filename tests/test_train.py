@@ -47,9 +47,13 @@ def test_learner_chunk_resolution():
         fc.runs_native = orig
     with pytest.raises(ValueError, match="learner_chunk"):
         DDPGConfig(learner_chunk=-1)
-    # The two rate caps point at each other and can livelock together.
-    with pytest.raises(ValueError, match="mutually"):
-        DDPGConfig(max_learn_ratio=1.0, max_ingest_ratio=50.0)
+    # The two rate caps point at each other: with ratio product < 1 each
+    # allowance waits on the other forever (livelock); product >= 1 is the
+    # equal-return gate's both-sides pin and must be accepted.
+    with pytest.raises(ValueError, match="livelock"):
+        DDPGConfig(max_learn_ratio=0.5, max_ingest_ratio=0.5)
+    DDPGConfig(max_learn_ratio=1.0, max_ingest_ratio=1.0)
+    DDPGConfig(max_learn_ratio=1.0, max_ingest_ratio=50.0)
 
 
 @pytest.mark.slow
