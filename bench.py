@@ -119,7 +119,9 @@ def _fill_replay(config, n=100_000):
 
 def _assert_platform() -> None:
     from distributed_ddpg_tpu.platform_util import honor_jax_platforms
+    from distributed_ddpg_tpu.train import _enable_faulthandler
 
+    _enable_faulthandler()
     honor_jax_platforms()
 
 
@@ -329,11 +331,44 @@ def phase_scaling() -> dict:
     return {"scaling_cpu_virtual": curves}
 
 
+def phase_study() -> dict:
+    """Megakernel-vs-scan study (BENCH_STUDY=1): steps/s and MFU at the
+    production chunk for batch {64, 256, 1024}, both paths. Justifies the
+    production defaults (fused_chunk='auto', chunk 800, batch 64) from
+    measurement instead of lore."""
+    import jax
+
+    _assert_platform()
+    seconds = float(os.environ.get("BENCH_SECONDS", "6"))
+    points = {}
+    for batch in (64, 256, 1024):
+        for mode in ("auto", "off"):
+            key = f"b{batch}_{'fused' if mode == 'auto' else 'scan'}"
+            # Per-point isolation: one failing point (e.g. the kernel at a
+            # batch far outside its tuned envelope) must not discard the
+            # rest of the grid.
+            try:
+                config = _config().replace(
+                    batch_size=batch, fused_chunk=mode
+                )
+                replay = _fill_replay(config, n=40_000)
+                r = _measure_jax(config, replay, seconds)
+                points[key] = {
+                    "grad_steps_per_sec": round(r["rate"], 1),
+                    "fused_chunk_active": r["fused_chunk_active"],
+                    **({"mfu": round(r["mfu"], 5)} if "mfu" in r else {}),
+                }
+            except Exception as e:
+                points[key] = {"error": repr(e)[:300]}
+    return {"study": points}
+
+
 _PHASES = {
     "native": phase_native,
     "probe": phase_probe,
     "jax": phase_jax,
     "scaling": phase_scaling,
+    "study": phase_study,
 }
 
 
@@ -458,6 +493,13 @@ def main() -> int:
             result["mfu"] = round(accel["mfu"], 5)
         if native:
             result["vs_baseline"] = round(accel["rate"] / native["native_rate"], 2)
+
+    if os.environ.get("BENCH_STUDY", "0") == "1" and accel:
+        study, err = _run_phase("study", accel_env, timeout=1800)
+        if study:
+            result.update(study)
+        else:
+            errors.append(err)
 
     if os.environ.get("BENCH_SCALING", "1") != "0":
         scaling, err = _run_phase(

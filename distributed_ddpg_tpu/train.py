@@ -35,7 +35,8 @@ from distributed_ddpg_tpu.replay import make_replay
 def _enable_faulthandler() -> None:
     """Stack dumps on demand (kill -USR1 <pid>) and on hard faults — a
     wedged driver must be debuggable without a debugger attached. Called
-    from train() so every driver entry (CLI, ladder, bench) gets it."""
+    from train() (CLI and ladder entries) and from bench.py's phase
+    bootstrap (its subprocesses never enter train())."""
     import faulthandler
     import signal
 
@@ -290,23 +291,26 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     spec = spec_of(env)
     chunk = resolve_learner_chunk(config)
     min_fill = max(config.replay_min_size, config.batch_size)
+    n_proc = jax.process_count()
     if (
         config.max_learn_ratio > 0.0
         and config.max_ingest_ratio > 0.0
-        and chunk > (1.0 + config.max_learn_ratio) * min_fill
+        and chunk > (1.0 + config.max_learn_ratio * n_proc) * min_fill
     ):
         # With BOTH gates armed the first chunk must fit the combined
-        # initial allowance: ingest caps env at W = max(replay_min, batch),
-        # so the learner gate (learn + chunk <= W + learn_ratio * env)
-        # needs chunk <= (1 + learn_ratio) * W — otherwise neither counter
-        # ever advances. (The config-level product >= 1 check can't see the
-        # resolved chunk, so the full condition lives here.)
+        # initial allowance: EACH process's ingest caps its local env steps
+        # at W = max(replay_min, batch), the learner gate compares against
+        # the global sum (n_proc * W at most initially), so it needs
+        # chunk <= (1 + learn_ratio * n_proc) * W — otherwise neither
+        # counter ever advances. (The config-level product >= 1 check can't
+        # see the resolved chunk or process count, so the full condition
+        # lives here.)
         raise ValueError(
             f"learner chunk {chunk} exceeds the initial gate allowance "
-            f"(1 + max_learn_ratio) * {min_fill} = "
-            f"{(1.0 + config.max_learn_ratio) * min_fill:.0f}: the run "
-            "would livelock at startup. Lower learner_chunk or raise "
-            "replay_min_size."
+            f"(1 + max_learn_ratio * {n_proc}) * {min_fill} = "
+            f"{(1.0 + config.max_learn_ratio * n_proc) * min_fill:.0f}: "
+            "the run would livelock at startup. Lower learner_chunk or "
+            "raise replay_min_size."
         )
     learner = ShardedLearner(
         config,
