@@ -494,7 +494,14 @@ def main() -> int:
         if native:
             result["vs_baseline"] = round(accel["rate"] / native["native_rate"], 2)
 
-    if os.environ.get("BENCH_STUDY", "0") == "1" and accel:
+    # Study only makes sense against a healthy accelerator — after a CPU
+    # fallback (tpu_error set) each grid point would just re-fail or hang
+    # against the dead platform.
+    if (
+        os.environ.get("BENCH_STUDY", "0") == "1"
+        and accel
+        and "tpu_error" not in result
+    ):
         study, err = _run_phase("study", accel_env, timeout=1800)
         if study:
             result.update(study)

@@ -126,3 +126,8 @@ class Timer:
     def rate(self) -> float:
         dt = time.time() - self._t
         return self._n / dt if dt > 0 else 0.0
+
+    def exclude(self, seconds: float) -> None:
+        """Remove `seconds` from the measured window — for off-path work
+        (e.g. inline evals) that must not deflate the reported rate."""
+        self._t += seconds

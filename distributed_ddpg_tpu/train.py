@@ -83,12 +83,19 @@ def train_native(config: DDPGConfig) -> Dict[str, float]:
     learn_timer = Timer()
     learn_steps = 0
     metrics: Dict[str, float] = {}
+    ep_return, ep_returns = 0.0, []
+
+    # learner.act is the deterministic policy (no OU noise) — the same
+    # policy surface the jax path evaluates, so the two backends' eval
+    # curves are directly comparable (the quality gate, BASELINE.md).
+    eval_policy = learner.act
 
     obs, _ = env.reset(seed=config.seed)
     for step in range(1, config.total_env_steps + 1):
         action = learner.act(obs)[0] + noise() * spec.action_scale
         action = np.clip(action, spec.action_low, spec.action_high).astype(np.float32)
         next_obs, reward, terminated, truncated, _ = env.step(action)
+        ep_return += reward
         for tr in nstep.push(obs[None], action[None], [reward], [terminated], next_obs[None]):
             replay.add(*tr)
         obs = next_obs
@@ -96,6 +103,8 @@ def train_native(config: DDPGConfig) -> Dict[str, float]:
             obs, _ = env.reset()
             noise.reset()
             nstep.reset()
+            ep_returns.append(ep_return)
+            ep_return = 0.0
         if (
             len(replay) >= max(config.replay_min_size, config.batch_size)
             and step % config.train_every == 0
@@ -115,12 +124,33 @@ def train_native(config: DDPGConfig) -> Dict[str, float]:
                 learner_steps=learn_steps,
                 learner_steps_per_sec=learn_timer.rate(),
                 buffer_fill=len(replay),
+                episode_return=(
+                    float(np.mean(ep_returns)) if ep_returns else None
+                ),
                 **metrics,
             )
+            ep_returns = []
+            if learn_steps:  # past warmup: policy is being trained
+                # Inline eval is off-path work: exclude its wall time from
+                # the learner rate (the jax path runs evals on a background
+                # thread for the same reason) so the reported baseline
+                # steps/sec measures learning, not evaluation.
+                t_eval = time.time()
+                ret = _eval_numpy(eval_policy, config, spec)
+                learn_timer.exclude(time.time() - t_eval)
+                log.log("eval", step, eval_return=ret)
     rate = learn_timer.rate()
-    log.log("final", config.total_env_steps, learner_steps_per_sec=rate)
+    final_return = _eval_numpy(eval_policy, config, spec)
+    log.log(
+        "final", config.total_env_steps,
+        learner_steps_per_sec=rate, final_return=final_return,
+    )
     log.close()
-    return {"learner_steps_per_sec": rate, "learner_steps": learn_steps}
+    return {
+        "learner_steps_per_sec": rate,
+        "learner_steps": learn_steps,
+        "final_return": final_return,
+    }
 
 
 # ---------------------------------------------------------------------------
