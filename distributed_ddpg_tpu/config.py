@@ -122,6 +122,13 @@ class DDPGConfig:
     fused_chunk: str = "auto"
 
     # --- run control ---
+    # Stall watchdog (watchdog.py): if the jax_tpu trainer makes no
+    # progress for this many seconds — including during learner
+    # construction and the first params d2h, both unbounded blocking
+    # device calls on a tunneled TPU — dump every thread's stack and
+    # hard-exit(70) instead of hanging silently. 0 = off (tests and
+    # interactive runs); production/ladder runs should set ~300.
+    watchdog_s: float = 0.0
     total_env_steps: int = 100_000
     eval_every: int = 5_000
     eval_episodes: int = 5

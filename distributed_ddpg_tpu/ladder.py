@@ -37,7 +37,13 @@ _COMMON = dict(actor_hidden=(256, 256), critic_hidden=(256, 256))
 # reference's sync replay ratio, which the equal-return gate compares
 # against. Free-running async (the throughput mode bench.py measures)
 # is a flag away: --max_learn_ratio=0 --max_ingest_ratio=0.
-_GATED = dict(max_learn_ratio=1.0, max_ingest_ratio=1.0, **_COMMON)
+# watchdog_s: ladder runs are driver-managed wall-clock budgets — a wedged
+# device/tunnel must crash loudly (watchdog.py, exit 70) instead of eating
+# the budget as a silent hang (observed in-round: a PJRT init that never
+# returned after the remote tunnel dropped).
+_GATED = dict(
+    max_learn_ratio=1.0, max_ingest_ratio=1.0, watchdog_s=300.0, **_COMMON
+)
 
 RUNGS: Dict[int, DDPGConfig] = {
     1: DDPGConfig(

@@ -299,6 +299,33 @@ def _jax_env_spec(trainer):
 
 
 def train_jax(config: DDPGConfig) -> Dict[str, float]:
+    # Stall watchdog (watchdog.py): covers the WHOLE device lifetime of
+    # the impl below — backend/PJRT init (resolve_learner_chunk's
+    # platform probe and ShardedLearner), the first params d2h at
+    # pool.start, every loop iteration, and teardown — any of which is
+    # an unbounded blocking call that a wedged device/tunnel turns into
+    # a silent hang. The beat counter advances at each supervised
+    # milestone; the wrapper guarantees the watchdog dies with the call
+    # (a leaked watchdog would os._exit a process that already
+    # recovered from an ordinary exception).
+    _beat_n = [0]
+
+    def _beat() -> None:
+        _beat_n[0] += 1
+
+    watchdog = None
+    if config.watchdog_s > 0:
+        from distributed_ddpg_tpu.watchdog import Watchdog
+
+        watchdog = Watchdog(config.watchdog_s, progress=lambda: _beat_n[0]).start()
+    try:
+        return _train_jax_impl(config, _beat)
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+
+
+def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
     import jax
 
     from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
@@ -350,6 +377,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         spec.action_offset,
         chunk_size=chunk,
     )
+    _beat()  # backend init + learner construction survived
     # Replay lives ON DEVICE (zero h2d in the steady state) for both
     # uniform and prioritized modes (replay/device.py; the PER priority
     # vector is device-resident too). config.host_replay forces the host
@@ -397,6 +425,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         )
 
     pool.start(learner.actor_params_to_host())
+    _beat()  # first params d2h survived (an observed wedge point)
     log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
     learn_timer, env_timer = Timer(), Timer()
     phases = PhaseTimers()
@@ -622,6 +651,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             # force pads a block from sub-block trickles so slow actors
             # still cross the threshold.
             moved = ingest_once(force_ship=(warm_it % 20 == 19))
+            _beat()
             pool.monitor()
             if (
                 use_device_replay
@@ -655,6 +685,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
             it = 0
             cached_global = 0
             while True:
+                _beat()
                 if is_multi:
                     if it % 10 == 0:
                         cached_global = global_env_steps()
@@ -710,15 +741,19 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         if prefetch is not None:
             prefetch.stop()
     finally:
+        _beat()  # each teardown stage gets a fresh watchdog allowance
         pool.stop()
+        _beat()
         # Land the in-flight checkpoint write (and surface its error, if
         # any) before callers read the directory back.
         saver.wait()
+        _beat()
         t = eval_thread["t"]
         if t is not None:
             t.join(timeout=60)
 
     # --- final eval with the trained policy (CPU, deterministic) ---
+    _beat()
     eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
     final_return = _eval_numpy(eval_policy, config, spec)
     rate = learn_timer.rate()

@@ -1,0 +1,99 @@
+"""Stall watchdog: failure detection for the device-bound hot loop
+(SURVEY.md §5 'Failure detection' row).
+
+The actor side already has heartbeats + respawn (actors/pool.py) because
+workers are stateless. The LEARNER side's failure mode is different: every
+device interaction (`device_get`, dispatch, even PJRT client creation on a
+tunneled TPU) is a potentially-unbounded blocking call with no timeout
+parameter, so a wedged device/transport turns the trainer into a silent
+hang — observed in-round as a `jax.device_get` that never returned after
+the remote tunnel dropped. A hang is the worst outcome for a driver-managed
+run: a crash gets retried/diagnosed, a hang eats the whole wall-clock
+budget.
+
+`Watchdog` converts that hang into a loud, debuggable crash: a daemon
+thread samples a progress value; if it stops advancing for `timeout_s`,
+the watchdog dumps EVERY thread's stack to stderr (faulthandler — shows
+exactly which device call wedged) and hard-exits via `os._exit` (the
+default `on_stall`). `os._exit` is deliberate: normal teardown would block
+on the same wedged device (pool.stop syncs, AsyncSaver waits), and atexit
+handlers of a wedged PJRT client can hang too.
+
+Enabled by `config.watchdog_s > 0` (train.py wires it around train_jax's
+whole device lifetime, including learner construction and the first
+params d2h — both observed wedge points)."""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+_EXIT_CODE = 70  # EX_SOFTWARE: internal failure, distinguishable from OOM/kill
+
+
+def _default_on_stall(timeout_s: float) -> None:
+    sys.stderr.write(
+        f"\n=== watchdog: no trainer progress for {timeout_s:.0f}s — "
+        "dumping all thread stacks and aborting (a blocking device call "
+        f"has likely wedged; exit code {_EXIT_CODE}) ===\n"
+    )
+    sys.stderr.flush()
+    faulthandler.dump_traceback(all_threads=True)
+    os._exit(_EXIT_CODE)
+
+
+class Watchdog:
+    """Fire `on_stall` if `progress()` stops changing for `timeout_s`.
+
+    `progress` must be cheap, thread-safe, and must never touch the device
+    (a device call inside the watchdog would wedge the watchdog with the
+    thing it watches) — an int counter bumped by the supervised loop is the
+    intended shape.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        progress: Callable[[], object],
+        on_stall: Optional[Callable[[], None]] = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self._timeout_s = timeout_s
+        self._progress = progress
+        self._on_stall = on_stall or (lambda: _default_on_stall(timeout_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        self._thread = threading.Thread(
+            target=self._run, name="stall-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        last = self._progress()
+        last_change = time.monotonic()
+        # Poll well inside the timeout so a stall is detected within
+        # ~1.25x timeout_s worst-case.
+        poll = max(0.05, self._timeout_s / 4.0)
+        while not self._stop.wait(poll):
+            now_val = self._progress()
+            now = time.monotonic()
+            if now_val != last:
+                last = now_val
+                last_change = now
+            elif now - last_change >= self._timeout_s:
+                self._on_stall()
+                return

@@ -1,0 +1,81 @@
+"""Stall-watchdog unit tests (SURVEY.md §5 'Failure detection': the
+learner-side complement to the actor heartbeats tested in test_actors) and
+the train_jax wiring: the watchdog must fire on frozen progress, must NOT
+fire while progress advances or after stop(), and a watchdog-enabled
+training run must complete without a false positive."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_ddpg_tpu.watchdog import Watchdog
+
+
+def test_fires_on_frozen_progress():
+    fired = threading.Event()
+    w = Watchdog(timeout_s=0.3, progress=lambda: 0, on_stall=fired.set).start()
+    try:
+        assert fired.wait(timeout=2.0), "watchdog never fired on frozen progress"
+    finally:
+        w.stop()
+
+
+def test_silent_while_progress_advances():
+    fired = threading.Event()
+    beat = [0]
+
+    def pump():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 1.0:
+            beat[0] += 1
+            time.sleep(0.02)
+
+    w = Watchdog(
+        timeout_s=0.3, progress=lambda: beat[0], on_stall=fired.set
+    ).start()
+    try:
+        pump()
+        assert not fired.is_set(), "watchdog fired despite advancing progress"
+    finally:
+        w.stop()
+
+
+def test_stop_prevents_firing():
+    fired = threading.Event()
+    w = Watchdog(timeout_s=0.3, progress=lambda: 0, on_stall=fired.set).start()
+    w.stop()
+    assert not fired.wait(timeout=0.8), "watchdog fired after stop()"
+
+
+def test_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        Watchdog(timeout_s=0.0, progress=lambda: 0)
+
+
+def test_train_jax_with_watchdog_completes(tmp_path):
+    """A watchdog-enabled run must finish cleanly: the beats placed through
+    train_jax (init, warmup, loop, teardown) keep a healthy run ahead of
+    the timeout, and the wrapper stops the watchdog on return — no
+    delayed os._exit can hit the test process afterwards."""
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.train import train_jax
+
+    cfg = DDPGConfig(
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=1,
+        total_env_steps=600,
+        replay_min_size=128,
+        replay_capacity=5_000,
+        eval_every=0,
+        watchdog_s=60.0,  # generous: any stall this long is a real hang
+    )
+    out = train_jax(cfg)
+    assert out["learner_steps"] > 0
+    # The watchdog thread must be gone (stopped by the wrapper).
+    time.sleep(0.1)
+    assert not any(
+        t.name == "stall-watchdog" and t.is_alive()
+        for t in threading.enumerate()
+    )
