@@ -1,0 +1,33 @@
+#!/bin/bash
+# Staleness-knob sweep (SURVEY.md §7 hard-part (b); docs/EVIDENCE.md §4):
+# HalfCheetah-v4, 16 actors, 300k env steps, seed 0, varying the
+# learner-rate cap (grad steps per env step). ratio 1 both sides is the
+# reference's sync semantics; 0 is free-running async (the learner runs as
+# fast as the device allows). Watchdog on: a wedged tunnel must fail the
+# run loudly (exit 70), not eat the sweep.
+set -u
+cd "$(dirname "$0")/.."
+COMMON="--backend=jax_tpu --env_id=HalfCheetah-v4 --num_actors=16
+        --total_env_steps=300000 --seed=0 --eval_every=30000
+        --eval_episodes=3 --watchdog_s=300"
+FAILED=0
+run() { # name, extra flags...
+  local name="$1"; shift
+  echo "=== staleness sweep: $name $*"
+  local rc=0
+  python -m distributed_ddpg_tpu.train $COMMON "$@" \
+    --log_path="runs/r3_staleness_${name}.jsonl" || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "=== staleness sweep: $name FAILED (rc=$rc)" >&2
+    FAILED=$((FAILED + 1))   # keep sweeping — later points still have value
+  fi
+}
+run ratio1  --max_learn_ratio=1 --max_ingest_ratio=1
+run ratio4  --max_learn_ratio=4
+run ratio16 --max_learn_ratio=16
+run free
+if [ "$FAILED" -gt 0 ]; then
+  echo "SWEEP_INCOMPLETE: $FAILED run(s) failed" >&2
+  exit 1
+fi
+echo SWEEP_DONE
