@@ -14,6 +14,10 @@ FAILED=0
 run() { # name, extra flags...
   local name="$1"; shift
   echo "=== staleness sweep: $name $*"
+  # Fresh artifact per attempt: the metrics sink appends, so a rerun after
+  # a failed/partial run would interleave two step sequences in the JSONL
+  # that docs/EVIDENCE.md cites.
+  rm -f "runs/r3_staleness_${name}.jsonl"
   local rc=0
   python -m distributed_ddpg_tpu.train $COMMON "$@" \
     --log_path="runs/r3_staleness_${name}.jsonl" || rc=$?

@@ -137,6 +137,10 @@ def run_train_parity(tag: str) -> None:
         eval_every=0,
         eval_episodes=1,
         log_path=tempfile.mktemp(suffix=".jsonl"),
+        # Watchdog under LOCKSTEP collectives: a healthy 2-process run must
+        # not false-fire (beats advance through the collective waits); a
+        # genuinely wedged peer stalls both processes and both exit 70.
+        watchdog_s=120.0,
     )
     out = train_jax(config)
     print(
