@@ -25,7 +25,8 @@ emits the JSON line, and exits 0 as long as the native baseline ran.
 
 Env overrides: JAX_PLATFORMS / BENCH_PLATFORM force the accelerator phase's
 platform (smoke-testing); BENCH_SECONDS scales measurement length;
-BENCH_SCALING=0 skips the virtual-device scaling curve.
+BENCH_SCALING=0 skips the virtual-device scaling curve; BENCH_CHUNK
+overrides the learner chunk length for the accelerator phase.
 """
 
 from __future__ import annotations
@@ -264,6 +265,10 @@ def phase_jax() -> dict:
     config = _config()
     if os.environ.get("BENCH_FUSED", "") == "off":
         config = config.replace(fused_chunk="off")
+    if os.environ.get("BENCH_CHUNK", ""):
+        # Chunk-length experiments (per-chunk dispatch overhead amortizes
+        # with K): override the resolved learner chunk for this phase only.
+        config = config.replace(learner_chunk=int(os.environ["BENCH_CHUNK"]))
     replay = _fill_replay(config)
     try:
         return _measure_jax(config, replay, seconds)
