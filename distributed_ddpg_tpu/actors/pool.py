@@ -19,6 +19,7 @@ runtime state.
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import queue as queue_mod
 import time
 from typing import Dict, List, Optional
@@ -132,6 +133,11 @@ class ActorPool:
                 gamma=self.config.gamma,
                 fault_step=fault_step,
                 episode_queue=self._episodes,
+                # Orphan guard (worker.py): the worker compares getppid()
+                # against the pool process's REAL pid, captured here at
+                # spawn time — a late in-worker getppid() capture races
+                # with a pool that dies during worker boot.
+                parent_pid=os.getpid(),
             ),
             daemon=True,
             name=f"actor-{worker_id}",

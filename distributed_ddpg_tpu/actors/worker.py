@@ -44,6 +44,7 @@ def run_worker(
     send_every: int = 32,
     fault_step: int = 0,
     episode_queue=None,     # optional mp.Queue for (worker_id, return, length)
+    parent_pid: int = 0,    # pool process pid, captured at spawn time
 ) -> None:
     # Workers are CPU-only by construction; make BLAS behave in many procs.
     os.environ.setdefault("OMP_NUM_THREADS", "1")
@@ -123,6 +124,8 @@ def run_worker(
             # worker) until the learner drains the ring. This throttles env
             # stepping instead of dropping experience.
             while carry is not None and not stop_flag.value:
+                if parent_pid and os.getppid() != parent_pid:
+                    return  # orphaned mid-backpressure: drainer is gone
                 accepted = ring.push(carry)
                 carry = carry[accepted:] if accepted < carry.shape[0] else None
                 if carry is not None:
@@ -138,7 +141,19 @@ def run_worker(
             "discount": np.asarray([p[3] for p in pending], np.float32),
             "next_obs": np.stack([p[4] for p in pending]),
         }
-        transition_queue.put((worker_id, seen_version, batch))
+        # The queue is BOUNDED (pool maxsize): a blocking put() on a full
+        # queue whose drainer died would hang past the orphan guard, so
+        # mirror the ring path — bounded waits with the guard between them.
+        import queue as queue_mod
+
+        while not stop_flag.value:
+            if parent_pid and os.getppid() != parent_pid:
+                return  # orphaned mid-backpressure: drainer is gone
+            try:
+                transition_queue.put((worker_id, seen_version, batch), timeout=0.1)
+                break
+            except queue_mod.Full:
+                heartbeat[worker_id] = time.time()
         pending.clear()
 
     maybe_refresh()
@@ -146,7 +161,17 @@ def run_worker(
     noise.reset()
     ep_return, ep_len, total_steps = 0.0, 0, 0
 
+    # Orphan guard: stop_flag is only ever set by pool.stop(), which a
+    # hard-killed pool process (SIGKILL, watchdog os._exit) never runs —
+    # daemon=True also doesn't help there, since the interpreter's atexit
+    # cleanup is skipped. A reparented worker (getppid no longer the pool
+    # pid passed at spawn — capturing getppid() here instead would race
+    # with a pool that dies during worker boot) has no consumer left, so
+    # it must exit — without flush(), whose ring backpressure would
+    # otherwise block forever on the dead drainer.
     while not stop_flag.value:
+        if parent_pid and os.getppid() != parent_pid:
+            return
         heartbeat[worker_id] = time.time()
         maybe_refresh()
         action = policy(obs)[0] + noise() * np.asarray(action_scale, np.float32)

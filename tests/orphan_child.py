@@ -1,0 +1,53 @@
+"""Subprocess helper for test_workers_exit_when_pool_dies_hard: start an
+ActorPool, print the worker pids, then die WITHOUT pool.stop() — the same
+exit a SIGKILL or the stall watchdog's os._exit(70) produces. The parent
+test asserts the workers notice the reparenting and exit on their own.
+
+Modes (argv[1]):
+  boot    die immediately after start() — workers are still booting and
+          must catch the orphaning at their first loop-top guard.
+  midrun  die after the workers have filled the BOUNDED queue transport —
+          workers are blocked inside put() backpressure and must escape
+          via the guarded timeout loop, not hang on the dead drainer."""
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_ddpg_tpu.actors.pool import ActorPool  # noqa: E402
+from distributed_ddpg_tpu.config import DDPGConfig  # noqa: E402
+from distributed_ddpg_tpu.envs import make, spec_of  # noqa: E402
+from distributed_ddpg_tpu.learner import init_train_state  # noqa: E402
+
+
+def main() -> None:
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1",
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=2,
+        transport="queue",  # no native .so dependency in this test
+    )
+    env = make(cfg.env_id, seed=0, prefer_builtin=True)
+    spec = spec_of(env)
+    state = init_train_state(cfg, spec.obs_dim, spec.act_dim, seed=0)
+    pool = ActorPool(cfg, spec)
+    pool.start(jax.device_get(state.actor_params))
+    print("PIDS", " ".join(str(p.pid) for p in pool._procs), flush=True)
+    if len(sys.argv) > 1 and sys.argv[1] == "midrun":
+        # Never drain: builtin-Pendulum workers boot in a couple of
+        # seconds and fill the bounded queue almost immediately after,
+        # so by the deadline they are blocked in put() backpressure.
+        deadline = time.time() + 10
+        while time.time() < deadline and pool._queue.qsize() < pool._queue._maxsize:
+            time.sleep(0.2)
+    # Hard death: no stop_flag, no atexit, no daemon cleanup.
+    os._exit(70)
+
+
+if __name__ == "__main__":
+    main()

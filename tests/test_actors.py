@@ -32,6 +32,68 @@ def _setup(num_actors=2, **kw):
     return cfg, spec, state
 
 
+@pytest.mark.parametrize("mode", ["boot", "midrun"])
+def test_workers_exit_when_pool_dies_hard(mode):
+    """Orphan guard (worker.py): a pool process that dies WITHOUT stop() —
+    SIGKILL, or the stall watchdog's os._exit — must not leave workers
+    running forever (observed in-round: 64 orphaned Humanoid workers after
+    a hard kill). 'boot' kills the pool before workers finish booting
+    (first loop-top guard catches it); 'midrun' kills it while workers are
+    blocked in full-transport put() backpressure (the guarded timeout loop
+    must catch it — a bare blocking put would hang forever)."""
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "orphan_child.py"),
+         mode],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={
+            **os.environ,
+            "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        },
+    )
+    assert out.returncode == 70, f"child failed to set up: {out.stderr[-2000:]}"
+    pids = [int(p) for line in out.stdout.splitlines()
+            if line.startswith("PIDS") for p in line.split()[1:]]
+    assert pids, f"no worker pids reported: {out.stdout!r}"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = [p for p in pids if _pid_alive(p)]
+        if not alive:
+            return
+        time.sleep(0.5)
+    # Clean up before failing so orphans don't leak into other tests.
+    for p in alive:
+        try:
+            os.kill(p, 9)
+        except OSError:
+            pass
+    raise AssertionError(f"orphaned workers still alive after 30s: {alive}")
+
+
+def _pid_alive(pid: int) -> bool:
+    import os
+
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    # A reaped-by-init zombie still answers signal 0; read the state.
+    # No /proc (non-Linux): assume alive — the conservative answer keeps
+    # the test honest instead of vacuously passing on live orphans.
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split(")")[-1].split()[0] != "Z"
+    except OSError:
+        return not os.path.exists("/proc")
+
+
 def test_numpy_policy_matches_jax_actor():
     import jax
 
