@@ -104,11 +104,29 @@ def run(rung: int, smoke: bool = False, log_dir: str = "") -> Dict[str, float]:
             log_path=os.path.join(log_dir, f"rung{rung}_{config.env_id}.jsonl")
         )
     summary = train(config)
+    # platform: the backend field says which CODE PATH ran (jax_tpu = the
+    # sharded mesh learner); the platform says which HARDWARE it ran on —
+    # a jax_tpu rung executes fine on CPU (dev boxes, outages), and a
+    # record that doesn't say so misreads as a TPU measurement. The native
+    # rung is CPU by definition and must stay off the accelerator: an
+    # unconditional jax.devices() here would INITIALIZE the default (TPU)
+    # backend that the whole native path deliberately never touches — and
+    # hang the finished measurement on a wedged tunnel. For jax backends
+    # the train run already initialized the backend, so this is a lookup,
+    # not an init.
+    if config.backend == "native":
+        platform = "cpu"
+    else:
+        import jax
+
+        platform = jax.devices()[0].platform
+
     record = {
         "kind": "ladder",
         "rung": rung,
         "env_id": config.env_id,
         "backend": config.backend,
+        "platform": platform,
         "num_actors": config.num_actors,
         "prioritized": config.prioritized,
         **{k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()},
