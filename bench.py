@@ -241,6 +241,11 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
 def phase_probe() -> dict:
     """Cheap accelerator-backend health check: initialize the platform and
     run one tiny op. Keeps the expensive bench phase off dead backends."""
+    if os.environ.get("BENCH_SELFTEST_HANG") == "1":
+        # Diagnostics selftest: wedge before device init so the phase
+        # deadline's faulthandler dump fires — verifies a real tunnel wedge
+        # produces a stack in tpu_error instead of a bare "timeout".
+        time.sleep(3600)
     import jax
 
     _assert_platform()
@@ -390,6 +395,12 @@ def _run_phase(name: str, env_overrides: dict, timeout: float):
     # failing op/spec instead of JAX's "internal frames removed" stub
     # (ADVICE.md round 2).
     env.setdefault("JAX_TRACEBACK_FILTERING", "off")
+    # Child arms faulthandler.dump_traceback_later just inside this deadline
+    # (see main's --phase entry), so a wedged phase self-dumps every thread's
+    # stack to stderr and exits BEFORE the parent's kill — the recorded
+    # error then names the wedged call (tunnel? compile? d2h?) instead of a
+    # bare "timeout after Ns" (VERDICT.md r3 Weak #8).
+    env["BENCH_PHASE_TIMEOUT"] = str(timeout)
     env.update({k: str(v) for k, v in env_overrides.items()})
     try:
         proc = subprocess.run(
@@ -397,10 +408,17 @@ def _run_phase(name: str, env_overrides: dict, timeout: float):
             capture_output=True, text=True, timeout=timeout, env=env,
         )
     except subprocess.TimeoutExpired:
-        return None, f"{name}: timeout after {timeout:.0f}s"
+        return None, f"{name}: timeout after {timeout:.0f}s (no self-dump)"
     if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        return None, f"{name}: rc={proc.returncode}: " + " | ".join(tail[-3:])
+        text = (proc.stderr or proc.stdout or "").strip()
+        lines = text.splitlines()
+        if "Timeout (0:" in text or "Thread 0x" in text:
+            # Self-dump fired: keep enough of the dump to see the wedged
+            # frame on every thread (bounded so tpu_error stays readable).
+            tail = " | ".join(lines[-25:])[-2500:]
+        else:
+            tail = " | ".join(lines[-3:])
+        return None, f"{name}: rc={proc.returncode}: " + tail
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -417,6 +435,18 @@ def main() -> int:
     args = parser.parse_args()
 
     if args.phase:
+        deadline = float(os.environ.get("BENCH_PHASE_TIMEOUT", "0"))
+        if deadline > 15:
+            # Self-dump shortly before the parent would SIGKILL us, so
+            # stderr carries all thread stacks (exit=True makes this an
+            # _exit — a wedged PJRT call can't block teardown). The margin
+            # scales: a flat -10s on a small deadline would kill a healthy
+            # slow phase at a fraction of its granted time.
+            import faulthandler
+
+            faulthandler.dump_traceback_later(
+                max(deadline - 10.0, 0.8 * deadline), exit=True
+            )
         print(json.dumps(_PHASES[args.phase]()), flush=True)
         return 0
 
@@ -446,8 +476,9 @@ def main() -> int:
     # probes, not 3 full bench timeouts.
     accel = None
     probe = None
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
     for attempt in range(3):
-        probe, err = _run_phase("probe", accel_env, timeout=180)
+        probe, err = _run_phase("probe", accel_env, timeout=probe_timeout)
         if probe and probe.get("ok"):
             break
         probe = None

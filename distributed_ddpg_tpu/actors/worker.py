@@ -146,14 +146,25 @@ def run_worker(
         # mirror the ring path — bounded waits with the guard between them.
         import queue as queue_mod
 
+        delivered = False
         while not stop_flag.value:
             if parent_pid and os.getppid() != parent_pid:
                 return  # orphaned mid-backpressure: drainer is gone
             try:
                 transition_queue.put((worker_id, seen_version, batch), timeout=0.1)
+                delivered = True
                 break
             except queue_mod.Full:
                 heartbeat[worker_id] = time.time()
+        if not delivered:
+            # Clean shutdown (stop_flag set before or during the loop):
+            # one non-blocking attempt delivers the tail when there's room;
+            # a full queue drops it — bounded loss (< send_every rows),
+            # matching the ring path's shutdown behavior.
+            try:
+                transition_queue.put_nowait((worker_id, seen_version, batch))
+            except queue_mod.Full:
+                pass
         pending.clear()
 
     maybe_refresh()

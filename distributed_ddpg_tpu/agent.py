@@ -5,8 +5,8 @@ together behind the reference's agent surface — `act(state)`,
 
 This class is the single-process composition (ladder rung 1,
 BASELINE.json:7). The distributed composition reuses the same pieces:
-actors/ run `act`+`observe` in worker processes, learner_loop.py runs
-`train_step` against the sharded mesh learner (parallel/learner.py).
+actors/ run `act`+`observe` in worker processes, the train.py driver loop
+runs `train_step` against the sharded mesh learner (parallel/learner.py).
 """
 
 from __future__ import annotations

@@ -11,7 +11,8 @@ One pure function performs, in a single traced XLA program:
 The reference crosses the worker<->parameter-server gRPC boundary three times
 per step (params pull, grads push, target assign — SURVEY.md §3.3). Here the
 step compiles to one device program: zero host crossings; the only transfers
-are the incoming minibatch (double-buffered, learner_loop.py) and the
+are the incoming minibatch (double-buffered via train.py's ChunkPrefetcher)
+and the
 outgoing per-sample TD errors for PER priority updates.
 
 `axis_name` threads an explicit `jax.lax.psum` gradient AllReduce for the

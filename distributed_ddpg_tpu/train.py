@@ -318,14 +318,19 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
         from distributed_ddpg_tpu.watchdog import Watchdog
 
         watchdog = Watchdog(config.watchdog_s, progress=lambda: _beat_n[0]).start()
+
+    def _grant(extra_s: float) -> None:
+        if watchdog is not None:
+            watchdog.grant(extra_s)
+
     try:
-        return _train_jax_impl(config, _beat)
+        return _train_jax_impl(config, _beat, _grant)
     finally:
         if watchdog is not None:
             watchdog.stop()
 
 
-def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
+def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> Dict[str, float]:
     import jax
 
     from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
@@ -642,6 +647,28 @@ def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
 
     try:
         # --- warmup: fill replay to the learning threshold (min_fill) ---
+        # The per-iteration _beat below keeps the watchdog quiet even when
+        # ingest_once() moves nothing, so a total actor-side stall (workers
+        # heartbeating but producing no experience — e.g. every env wedged)
+        # would otherwise burn the whole wall-clock budget unseen. The
+        # secondary deadline catches that: no rows for 10x watchdog_s is a
+        # loud RuntimeError (normal teardown runs — the learner thread
+        # itself is healthy here, unlike the device wedges the watchdog's
+        # os._exit exists for).
+        stall_deadline = (
+            10.0 * config.watchdog_s if config.watchdog_s > 0 else 0.0
+        )
+        last_moved_t = time.monotonic()
+
+        def _check_actor_stall(where: str) -> None:
+            if stall_deadline and time.monotonic() - last_moved_t > stall_deadline:
+                raise RuntimeError(
+                    f"{where}: no experience ingested for "
+                    f"{stall_deadline:.0f}s (10x watchdog_s) with the "
+                    "learner thread healthy — actor-side stall; aborting "
+                    "instead of burning the wall-clock budget"
+                )
+
         warm_it = 0
         while buffer_fill() < min_fill:
             # Lockstep warmup ingest: loop count is driven by the
@@ -660,7 +687,10 @@ def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
                 and buffer_fill() + len(device_replay._pending) >= min_fill
             ):
                 device_replay.flush()
-            if not moved:
+            if moved:
+                last_moved_t = time.monotonic()
+            else:
+                _check_actor_stall("warmup")
                 time.sleep(0.05)
             warm_it += 1
 
@@ -675,6 +705,14 @@ def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
         learn_timer.reset()
         env_timer.reset()
 
+        # The first dispatch includes the full XLA compile of the chunk
+        # program (~20-40s single-chip; larger nets / multihost meshes can
+        # take minutes) — grant the watchdog a one-time extra allowance so
+        # a slow compile isn't killed as a false stall (same exit 70 as a
+        # real wedge). Consumed by the first post-compile beat; steady-state
+        # iterations run on the plain watchdog_s window.
+        _grant(max(300.0, 2.0 * config.watchdog_s))
+
         with profile_cm:
             # Multi-host: the global budget is re-gathered every 10th
             # iteration, not every chunk — the cadence is deterministic in
@@ -684,6 +722,7 @@ def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
             # of ingest — noise against BASELINE-scale budgets.
             it = 0
             cached_global = 0
+            last_budget = -1
             while True:
                 _beat()
                 if is_multi:
@@ -692,9 +731,29 @@ def _train_jax_impl(config: DDPGConfig, _beat) -> Dict[str, float]:
                     budget_now = cached_global
                 else:
                     budget_now = env_steps()
-                if budget_now >= config.total_env_steps:
+                # Actor-stall coverage for EVERY post-warmup path (the
+                # per-iteration _beat keeps the watchdog quiet whether or
+                # not env steps arrive): with the default max_learn_ratio=0
+                # the loop below dispatches forever on stale replay if all
+                # workers wedge, and with a cap it spins in the ingest
+                # branch — either way env-step progress is the one signal
+                # that actors are alive, so it drives the stall clock.
+                if budget_now > last_budget:
+                    last_budget = budget_now
+                    last_moved_t = time.monotonic()
+                else:
+                    _check_actor_stall("train loop")
+                if budget_now >= config.total_env_steps and learn_steps > 0:
+                    # `learn_steps > 0` guards the degenerate exit where fast
+                    # actors deliver the entire env-step budget during warmup
+                    # (max_ingest_ratio=0 = free ingest): a run that has met
+                    # replay_min_size must take at least one gradient chunk
+                    # before the budget break is honored, or it would report
+                    # success with learner_steps=0. One chunk later the break
+                    # fires; learn_steps advances in lockstep on multi-host,
+                    # so every process exits on the same iteration.
                     break
-                if config.max_learn_ratio > 0.0 and (
+                if config.max_learn_ratio > 0.0 and learn_steps > 0 and (
                     learn_steps + chunk
                     > max(config.replay_min_size, config.batch_size)
                     + config.max_learn_ratio * budget_now

@@ -21,7 +21,16 @@ handlers of a wedged PJRT client can hang too.
 
 Enabled by `config.watchdog_s > 0` (train.py wires it around train_jax's
 whole device lifetime, including learner construction and the first
-params d2h — both observed wedge points)."""
+params d2h — both observed wedge points).
+
+Coverage note: this watchdog catches LEARNER-side wedges (device calls
+that never return). An actor-side stall — workers heartbeating but
+producing no experience — is the one hang it cannot see, because the
+warmup/cap loops beat every iteration whether or not rows moved; train.py
+closes that gap with a secondary deadline (no ingest for 10x watchdog_s
+raises a loud RuntimeError on the healthy learner thread). The first
+post-warmup dispatch gets a one-time `grant()` so its XLA compile isn't
+killed as a false stall."""
 
 from __future__ import annotations
 
@@ -69,6 +78,23 @@ class Watchdog:
         self._on_stall = on_stall or (lambda: _default_on_stall(timeout_s))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._grant_deadline = 0.0
+        self._grant_lock = threading.Lock()
+
+    def grant(self, extra_s: float) -> None:
+        """Suppress firing until `extra_s` seconds from NOW (wall-clock
+        deadline, not beat-relative): progress beats between grant() and the
+        protected long call must not consume the allowance — the caller
+        can't always avoid beating in between. Used for the first
+        post-warmup learner dispatch, which includes the full XLA compile
+        of the chunk program — worst-case compile (large nets, multihost
+        meshes) can exceed a `timeout_s` tuned for steady-state dispatch
+        latency, and a compile killed as a false stall exits 70 exactly
+        like a real wedge."""
+        with self._grant_lock:
+            self._grant_deadline = max(
+                self._grant_deadline, time.monotonic() + float(extra_s)
+            )
 
     def start(self) -> "Watchdog":
         self._thread = threading.Thread(
@@ -95,5 +121,8 @@ class Watchdog:
                 last = now_val
                 last_change = now
             elif now - last_change >= self._timeout_s:
-                self._on_stall()
-                return
+                with self._grant_lock:
+                    granted = now < self._grant_deadline
+                if not granted:
+                    self._on_stall()
+                    return

@@ -43,7 +43,14 @@ def main() -> None:
         # seconds and fill the bounded queue almost immediately after,
         # so by the deadline they are blocked in put() backpressure.
         deadline = time.time() + 10
-        while time.time() < deadline and pool._queue.qsize() < pool._queue._maxsize:
+        while time.time() < deadline:
+            try:
+                # qsize() raises NotImplementedError on macOS (no
+                # sem_getvalue); there the 10s deadline alone gates the kill.
+                if pool._queue.qsize() >= pool._queue._maxsize:
+                    break
+            except NotImplementedError:
+                pass
             time.sleep(0.2)
     # Hard death: no stop_flag, no atexit, no daemon cleanup.
     os._exit(70)

@@ -86,6 +86,27 @@ def test_train_jax_max_learn_ratio_caps_learner(tmp_path):
     assert out["learner_steps"] <= cfg.replay_min_size + cfg.total_env_steps * 1.1 + chunk
 
 
+def test_train_jax_tiny_budget_takes_at_least_one_chunk(tmp_path):
+    """Regression: with free ingest (max_ingest_ratio=0) a fast actor can
+    deliver the entire env-step budget during warmup. The budget break must
+    not fire before the first learner dispatch — a run that met
+    replay_min_size and reports success must have learner_steps > 0.
+    Budget == replay_min_size makes the overfill deterministic: warmup
+    necessarily consumes the whole budget."""
+    cfg = DDPGConfig(
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=1,
+        total_env_steps=128,
+        replay_min_size=128,
+        replay_capacity=5_000,
+        eval_every=0,
+        log_path=str(tmp_path / "metrics.jsonl"),
+    )
+    out = train_jax(cfg)
+    assert out["learner_steps"] > 0
+
+
 @pytest.mark.slow
 def test_train_jax_async_pipeline(tmp_path):
     cfg = DDPGConfig(

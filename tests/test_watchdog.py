@@ -48,6 +48,25 @@ def test_stop_prevents_firing():
     assert not fired.wait(timeout=0.8), "watchdog fired after stop()"
 
 
+def test_grant_suppresses_firing_until_deadline():
+    """grant(extra_s) is a wall-clock suppression window: the watchdog must
+    not fire during it even with frozen progress AND intervening beats
+    (beats between grant() and the protected long call must not consume
+    the allowance), and must fire once it expires."""
+    fired = threading.Event()
+    beat = [0]
+    w = Watchdog(
+        timeout_s=0.2, progress=lambda: beat[0], on_stall=fired.set
+    ).start()
+    try:
+        w.grant(1.2)
+        beat[0] += 1  # beat AFTER the grant — must not consume it
+        assert not fired.wait(timeout=0.8), "fired inside the grant window"
+        assert fired.wait(timeout=2.0), "never fired after the grant expired"
+    finally:
+        w.stop()
+
+
 def test_rejects_nonpositive_timeout():
     with pytest.raises(ValueError):
         Watchdog(timeout_s=0.0, progress=lambda: 0)
