@@ -120,6 +120,20 @@ class DDPGConfig:
     # the single-device TPU sample-chunk path whenever the config is in the
     # kernel's envelope; "on" requires it (error if unsupported); "off" never.
     fused_chunk: str = "auto"
+    # Megakernel x mesh composition (parallel/learner.py fused-mesh path):
+    # on a multi-device DATA-parallel mesh each device runs the megakernel
+    # on its own independent minibatch draws for the whole K-step chunk,
+    # and float state (params, targets, Adam moments) is AVERAGED across
+    # the data axis at chunk boundaries (one params-sized AllReduce per K
+    # steps instead of K per-step gradient psums — per-step sync would
+    # evict params from VMEM every step and forfeit the kernel's entire
+    # HBM-traffic win). This is K-step local SGD: sync semantics differ
+    # from the scan path's per-step psum by a bounded O(lr*K) divergence
+    # (docs/PERF_NOTES.md has the staleness argument + measured parity).
+    # "auto": compose whenever the megakernel is active and the mesh is
+    # data-only (model_axis == 1); "off": multi-device meshes always use
+    # the scan path (exact per-step sync).
+    fused_mesh: str = "auto"
 
     # --- run control ---
     # Stall watchdog (watchdog.py): if the jax_tpu trainer makes no
@@ -192,6 +206,10 @@ class DDPGConfig:
             raise ValueError(
                 f"fused_chunk must be 'auto', 'on', or 'off', got "
                 f"{self.fused_chunk!r}"
+            )
+        if self.fused_mesh not in ("auto", "off"):
+            raise ValueError(
+                f"fused_mesh must be 'auto' or 'off', got {self.fused_mesh!r}"
             )
         if self.max_ingest_ratio < 0:
             raise ValueError("max_ingest_ratio must be >= 0 (0 = unlimited)")

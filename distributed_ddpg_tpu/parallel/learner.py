@@ -41,6 +41,7 @@ from distributed_ddpg_tpu.learner import (
 from distributed_ddpg_tpu.parallel import mesh as mesh_lib
 from distributed_ddpg_tpu.types import (
     Batch,
+    OptState,
     TrainState,
     pack_batch_np,
     unpack_batch,
@@ -223,8 +224,7 @@ class ShardedLearner:
             return scan_steps(s, unpack_batch(packed, obs_dim, act_dim)), key
 
         # Pallas megakernel path (ops/fused_chunk.py): the whole chunk in one
-        # kernel, params VMEM-resident. Single-device only — on a >1-device
-        # mesh the XLA scan path's sharding + collectives stay in charge.
+        # kernel, params VMEM-resident.
         from distributed_ddpg_tpu.ops import fused_chunk as fused_chunk_lib
 
         # "auto" additionally requires a real TPU (elsewhere the kernel would
@@ -232,25 +232,42 @@ class ShardedLearner:
         # scan; "on" forces it anywhere, tests use this) and mode="auto":
         # mode="explicit" exists to make the shard_map path observable, so it
         # must never be silently replaced by the megakernel.
-        self.fused_chunk_active = (
+        envelope_ok = (
             config.fused_chunk != "off"
             and self.mode == "auto"
-            and self.mesh.size == 1
             and fused_chunk_lib.supported(config)
             and fused_chunk_lib.fits_vmem(config, obs_dim, act_dim)
             and (config.fused_chunk == "on" or fused_chunk_lib.runs_native())
         )
+        # Mesh composition (config.fused_mesh, VERDICT.md r3 Missing #3):
+        # on a DATA-only mesh every device runs the megakernel on its own
+        # independent draws for the whole chunk; float state is pmean'd at
+        # the chunk boundary (K-step local SGD — one params AllReduce per
+        # K steps, NOT K gradient psums, which would evict params from VMEM
+        # every step and forfeit the kernel's HBM-traffic win). TP
+        # (model_axis > 1) shards the param tensors the kernel needs whole,
+        # so the scan path keeps those meshes.
+        self.fused_mesh_active = (
+            envelope_ok
+            and self.mesh.size > 1
+            and self.mesh.shape["model"] == 1
+            and config.fused_mesh != "off"
+        )
+        self.fused_chunk_active = envelope_ok and (
+            self.mesh.size == 1 or self.fused_mesh_active
+        )
         if config.fused_chunk == "on" and not self.fused_chunk_active:
             raise ValueError(
                 "fused_chunk='on' but the config/mesh is outside the kernel "
-                "envelope: needs a single-device mesh, mode='auto', plus "
-                "distributional=False, action_insert_layer=1, critic_l2=0, "
-                "fused_update=False, compute_dtype='float32', >=2 critic "
-                "hidden layers, and nets small enough for VMEM "
-                "(ops/fused_chunk.fits_vmem)"
+                "envelope: needs mode='auto', a single-device or data-only "
+                "mesh (model_axis == 1, and fused_mesh != 'off' for "
+                "multi-device), plus distributional=False, "
+                "action_insert_layer=1, critic_l2=0, fused_update=False, "
+                "compute_dtype='float32', >=2 critic hidden layers, and "
+                "nets small enough for VMEM (ops/fused_chunk.fits_vmem)"
             )
         scan_sample_chunk_fn = sample_chunk_fn
-        if self.fused_chunk_active:
+        if self.fused_chunk_active and not self.fused_mesh_active:
             run_fused = fused_chunk_lib.make_fused_chunk_fn(
                 config, obs_dim, act_dim, action_scale, action_offset,
                 chunk_size=self.chunk_size,
@@ -262,6 +279,10 @@ class ShardedLearner:
                 return StepOutput(state=new_s, td_errors=tds, metrics=ms), key
 
             sample_chunk_fn = fused_sample_chunk_fn
+        elif self.fused_mesh_active:
+            sample_chunk_fn = self._make_fused_mesh_fn(
+                fused_chunk_lib, action_scale, action_offset
+            )
 
         # PER fused chunk (replay/device.py DevicePrioritizedReplay,
         # VERDICT.md round-1 Missing #4): stratified proportional draw from
@@ -345,6 +366,80 @@ class ShardedLearner:
         self.fused_chunk_error: Optional[str] = None
         self._key = jax.device_put(jax.random.PRNGKey(config.seed), replicated)
 
+    def _make_fused_mesh_fn(self, fused_chunk_lib, action_scale, action_offset):
+        """Megakernel x data-parallel mesh (VERDICT.md r3 Missing #3).
+
+        Every 'data'-axis device runs the whole K-step chunk in ONE pallas
+        launch on its OWN independent minibatch draws (storage is replicated,
+        so per-device draws from the full buffer are D independent batch
+        streams), then the float state — params, targets, Adam moments — is
+        pmean'd across the axis at the chunk boundary. That is K-step local
+        SGD: one params-sized AllReduce per K steps instead of the scan
+        path's K per-step gradient psums. Per-step sync inside the kernel
+        would force params back to HBM every step, forfeiting exactly the
+        VMEM-residency win the kernel exists for; at K=800 the boundary
+        AllReduce (~5 MB of state) amortizes to ~6 KB/step — below even the
+        batch stream. Divergence between replicas is bounded by O(lr * K)
+        drift per chunk (each replica's Adam update is clipped to ~lr per
+        step by normalization); docs/PERF_NOTES.md carries the measured
+        parity + staleness argument. Adam counts/step advance identically
+        on every replica and pass through un-averaged."""
+        K = self.chunk_size
+        b_local = self.global_batch // self.data_size
+        run_fused = fused_chunk_lib.make_fused_chunk_fn(
+            self.config.replace(batch_size=b_local),
+            self.obs_dim, self.act_dim, action_scale, action_offset,
+            chunk_size=K,
+        )
+        mesh = self.mesh
+        state_spec = mesh_lib.state_pspec(self.state, mesh)
+
+        def local_chunk(s, sub, storage, size):
+            dkey = jax.random.fold_in(sub, jax.lax.axis_index("data"))
+            idx = jax.random.randint(
+                dkey, (K, b_local), 0, jnp.maximum(size, 1)
+            )
+            new_s, tds, ms = run_fused(s, storage[idx])
+            avg = lambda x: jax.lax.pmean(x, "data")
+            favg = lambda tree: jax.tree.map(avg, tree)
+            new_s = TrainState(
+                actor_params=favg(new_s.actor_params),
+                critic_params=favg(new_s.critic_params),
+                target_actor_params=favg(new_s.target_actor_params),
+                target_critic_params=favg(new_s.target_critic_params),
+                actor_opt=OptState(
+                    mu=favg(new_s.actor_opt.mu),
+                    nu=favg(new_s.actor_opt.nu),
+                    count=new_s.actor_opt.count,
+                ),
+                critic_opt=OptState(
+                    mu=favg(new_s.critic_opt.mu),
+                    nu=favg(new_s.critic_opt.nu),
+                    count=new_s.critic_opt.count,
+                ),
+                step=new_s.step,
+            )
+            return new_s, tds, {k: avg(v) for k, v in ms.items()}
+
+        sharded = jax.shard_map(
+            local_chunk,
+            mesh=mesh,
+            in_specs=(state_spec, P(), P(None, None), P()),
+            out_specs=(
+                state_spec,
+                P(None, "data"),
+                {k: P() for k in METRIC_KEYS},
+            ),
+            check_vma=False,
+        )
+
+        def fused_mesh_sample_chunk_fn(s: TrainState, key, storage, size):
+            key, sub = jax.random.split(key)
+            new_s, tds, ms = sharded(s, sub, storage, size)
+            return StepOutput(state=new_s, td_errors=tds, metrics=ms), key
+
+        return fused_mesh_sample_chunk_fn
+
     # --- single step ---
 
     def step(self, np_batch: Dict[str, np.ndarray]) -> StepOutput:
@@ -411,6 +506,7 @@ class ShardedLearner:
             )
             self.fused_chunk_error = repr(e)[:800]
             self.fused_chunk_active = False
+            self.fused_mesh_active = False  # scan = per-step psum semantics
             self._sample_chunk_step = self._scan_sample_chunk_step
             out, self._key = self._sample_chunk_step(
                 self.state, self._key, storage, size

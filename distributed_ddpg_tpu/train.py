@@ -723,6 +723,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             it = 0
             cached_global = 0
             last_budget = -1
+            first_dispatch_done = False
             while True:
                 _beat()
                 if is_multi:
@@ -731,18 +732,6 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     budget_now = cached_global
                 else:
                     budget_now = env_steps()
-                # Actor-stall coverage for EVERY post-warmup path (the
-                # per-iteration _beat keeps the watchdog quiet whether or
-                # not env steps arrive): with the default max_learn_ratio=0
-                # the loop below dispatches forever on stale replay if all
-                # workers wedge, and with a cap it spins in the ingest
-                # branch — either way env-step progress is the one signal
-                # that actors are alive, so it drives the stall clock.
-                if budget_now > last_budget:
-                    last_budget = budget_now
-                    last_moved_t = time.monotonic()
-                else:
-                    _check_actor_stall("train loop")
                 if budget_now >= config.total_env_steps and learn_steps > 0:
                     # `learn_steps > 0` guards the degenerate exit where fast
                     # actors deliver the entire env-step budget during warmup
@@ -753,6 +742,24 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     # fires; learn_steps advances in lockstep on multi-host,
                     # so every process exits on the same iteration.
                     break
+                # Actor-stall coverage for EVERY post-warmup path (the
+                # per-iteration _beat keeps the watchdog quiet whether or
+                # not env steps arrive): with the default max_learn_ratio=0
+                # the loop below dispatches forever on stale replay if all
+                # workers wedge, and with a cap it spins in the ingest
+                # branch — either way env-step progress is the one signal
+                # that actors are alive, so it drives the stall clock.
+                # AFTER the budget break: a budget already met during
+                # warmup is a finishing run, not a stall. The first
+                # dispatch resets the clock below (its XLA compile gets
+                # the same allowance the watchdog grant gives it — a
+                # compile longer than the deadline must not read as a
+                # stalled actor fleet).
+                if budget_now > last_budget:
+                    last_budget = budget_now
+                    last_moved_t = time.monotonic()
+                else:
+                    _check_actor_stall("train loop")
                 if config.max_learn_ratio > 0.0 and learn_steps > 0 and (
                     learn_steps + chunk
                     > max(config.replay_min_size, config.batch_size)
@@ -795,6 +802,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     with phases.phase("dispatch"):
                         out = learner.run_chunk_async(device_chunk)
                     after_chunk(out, indices)
+                if not first_dispatch_done:
+                    # The first dispatch blocks on the chunk program's XLA
+                    # compile (minutes on big meshes); that time must not
+                    # count against the actor-stall clock.
+                    first_dispatch_done = True
+                    last_moved_t = time.monotonic()
                 it += 1
 
         if prefetch is not None:

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Bounded TPU-tunnel liveness probe, logged — same incident-record pattern
+# as runs/r3_tpu_outage_probe.log. One line per attempt; exits the moment
+# a probe SUCCEEDS so a recovery is visible as the log's last line.
+LOG="${1:-runs/r4_tpu_probe.log}"
+INTERVAL="${2:-300}"
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout 90 python - <<'EOF' 2>&1
+import jax
+ds = jax.devices()
+print("OK", ds[0].platform, ds[0].device_kind, len(ds))
+EOF
+)
+  rc=$?
+  if [ $rc -eq 0 ] && echo "$out" | grep -q "^OK"; then
+    echo "$ts RECOVERED $(echo "$out" | grep '^OK')" >> "$LOG"
+    exit 0
+  else
+    echo "$ts WEDGED rc=$rc" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
