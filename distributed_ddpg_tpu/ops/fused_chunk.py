@@ -24,8 +24,21 @@ Semantics match learner.make_learner_step exactly: both gradients are taken
 against the PRE-update params of the step; tests/test_fused_chunk.py pins the
 kernel to the XLA scan path over a whole chunk.
 
+D4PG (C51, ops/losses.py:111-160 semantics) runs in the same kernel: the
+critic head emits num_atoms logits, the categorical projection is computed
+in-kernel as an unrolled accumulation over atoms — proj += p'[:, i:i+1] *
+relu(1 - |tz[:, i:i+1] - z|/dz), the triangular-kernel form of the
+lower/upper-neighbor mass split, rank-2 throughout so Mosaic never sees a
+3D tensor — and the hand-written backward uses the closed-form categorical
+cotangents (softmax(logits) - proj for the critic CE; -p * (z - E[Z]) / B
+for the actor's expected-value head).
+
+Mixed precision (config.compute_dtype='bfloat16') casts matmul operands to
+bf16 with f32 accumulation (`preferred_element_type`), forward AND backward,
+mirroring models/mlp._dense; params, Adam state, and activations stay f32.
+
 Supported envelope (callers must check `supported(config)`):
-  - non-distributional critic, action_insert_layer == 1, critic_l2 == 0
+  - action_insert_layer == 1, critic_l2 == 0
   - any MLP depths/widths that fit VMEM (the DDPG/D4PG families all do)
 
 On non-TPU backends the kernel runs in pallas interpret mode: numerics are
@@ -86,8 +99,10 @@ def state_vmem_bytes(config: DDPGConfig, obs_dim: int, act_dim: int) -> int:
 
     # obs/act enter the actor/critic input dims; action rides into critic
     # layer 1 (action_insert_layer == 1 inside the supported envelope).
+    # The C51 head widens the critic output to num_atoms logits.
+    out = config.num_atoms if config.distributional else 1
     a = net([obs_dim, *config.actor_hidden, act_dim])
-    c = net([obs_dim, *config.critic_hidden, 1], extra_in=act_dim)
+    c = net([obs_dim, *config.critic_hidden, out], extra_in=act_dim)
     return 4 * (4 * a + 4 * c)
 
 
@@ -102,34 +117,17 @@ def fits_vmem(config: DDPGConfig, obs_dim: int, act_dim: int) -> bool:
 
 def supported(config: DDPGConfig) -> bool:
     return (
-        not config.distributional
-        and config.action_insert_layer == 1
+        config.action_insert_layer == 1
         and config.critic_l2 == 0.0
         and not config.fused_update
-        and config.compute_dtype == "float32"  # kernel matmuls are f32
+        and config.compute_dtype in ("float32", "bfloat16")
         # The hand-written backward assumes the action-insert layer (1) is
         # not the critic's output layer, i.e. at least 2 hidden layers.
         and len(config.critic_hidden) >= 2
         and len(config.actor_hidden) >= 1
-    )
-
-
-def _mm(a, b):
-    return jnp.dot(a, b, preferred_element_type=jnp.float32)
-
-
-def _dW(x, dz):
-    # x: [B, in], dz: [B, out] -> [in, out]; contract the batch dim without
-    # materializing a transpose.
-    return jax.lax.dot_general(
-        x, dz, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-
-
-def _dx(dz, w):
-    # dz: [B, out], w: [in, out] -> [B, in]; contract out dims.
-    return jax.lax.dot_general(
-        dz, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        # The C51 projection unrolls num_atoms accumulation steps at trace
+        # time; cap it so a pathological config can't explode the kernel.
+        and (not config.distributional or config.num_atoms <= 256)
     )
 
 
@@ -145,6 +143,36 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
     inv_b = 1.0 / float(batch)
     inv_k = 1.0 / float(chunk)
     na2, nc2 = 2 * n_actor, 2 * n_critic
+    distributional = bool(config.distributional)
+    num_atoms = int(config.num_atoms)
+    v_min, v_max = float(config.v_min), float(config.v_max)
+    dz_atom = (v_max - v_min) / (num_atoms - 1)
+
+    # Mixed precision: cast matmul operands to bf16, accumulate f32 —
+    # forward and backward alike (mirrors models/mlp._dense). Everything
+    # outside the dots (activations, Adam, Polyak, projection) stays f32.
+    if config.compute_dtype == "bfloat16":
+        cast = lambda x: x.astype(jnp.bfloat16)  # noqa: E731
+    else:
+        cast = lambda x: x  # noqa: E731
+
+    def _mm(a, b):
+        return jnp.dot(cast(a), cast(b), preferred_element_type=jnp.float32)
+
+    def _dW(x, dz):
+        # x: [B, in], dz: [B, out] -> [in, out]; contract the batch dim
+        # without materializing a transpose.
+        return jax.lax.dot_general(
+            cast(x), cast(dz), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def _dx(dz, w):
+        # dz: [B, out], w: [in, out] -> [B, in]; contract out dims.
+        return jax.lax.dot_general(
+            cast(dz), cast(w), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     def kernel(*refs):
         it = iter(range(len(refs)))
@@ -154,6 +182,8 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
 
         (count_ref,) = take(1)
         obs_r, act_r, rew_r, disc_r, nobs_r, wgt_r, scale_r, off_r = take(8)
+        if distributional:
+            (z_ref,) = take(1)  # categorical support, (1, num_atoms)
         actor_in = take(na2)
         critic_in = take(nc2)
         t_actor_in = take(na2)
@@ -231,14 +261,48 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
         # Target path (no grads).
         u_t, _ = actor_fwd(t_actor_o, nobs)
         q_t, _ = critic_fwd(t_critic_o, nobs, u_t)
-
-        y = rew + disc * q_t
         q, c_acts = critic_fwd(critic_o, obs, action)
-        td = y - q
 
-        # ---- critic backward --------------------------------------------
-        # L_c = mean(w * td^2); dL/dq = -2/B * w * td
-        dq = (-2.0 * inv_b) * wgt * td
+        if distributional:
+            # ---- C51 critic loss (losses.py:111-160 semantics) ----------
+            # q / q_t are [B, A] logit heads. Stable softmax over atoms.
+            z = z_ref[...]  # (1, A)
+            m_t = jnp.max(q_t, axis=-1, keepdims=True)
+            e_t = jnp.exp(q_t - m_t)
+            p_t = e_t / jnp.sum(e_t, axis=-1, keepdims=True)
+            # Projection of the Bellman-shifted target distribution onto
+            # the support, accumulated atom-by-atom (unrolled, rank-2):
+            # the triangular kernel relu(1 - |tz_i - z_j|/dz) IS the
+            # lower/upper-neighbor mass split of the classic projection
+            # (exact also when tz lands on an atom: weight 1 there, 0
+            # elsewhere). proj is constant w.r.t. online params — the
+            # target path carries no gradient, so forward-only is enough.
+            tz = jnp.clip(rew + disc * z, v_min, v_max)  # [B, A]
+            proj = jnp.zeros_like(q)
+            for i in range(num_atoms):
+                tri = jnp.maximum(
+                    0.0, 1.0 - jnp.abs(tz[:, i : i + 1] - z) / dz_atom
+                )
+                proj = proj + p_t[:, i : i + 1] * tri
+            m_q = jnp.max(q, axis=-1, keepdims=True)
+            e_q = jnp.exp(q - m_q)
+            sum_q = jnp.sum(e_q, axis=-1, keepdims=True)
+            p_q = e_q / sum_q
+            logp = q - (m_q + jnp.log(sum_q))
+            ce = -jnp.sum(proj * logp, axis=-1, keepdims=True)  # [B, 1]
+            closs = jnp.sum(wgt * ce) * inv_b
+            # PER proxy (losses.py docstring): E[Z_target] - E[Z].
+            mean_q_b = jnp.sum(p_q * z, axis=-1, keepdims=True)
+            td = jnp.sum(proj * z, axis=-1, keepdims=True) - mean_q_b
+            # d(mean(w * ce))/dlogits = w/B * (softmax(logits) - proj)
+            dq = (p_q - proj) * (wgt * inv_b)
+        else:
+            # ---- TD(0) critic loss --------------------------------------
+            y = rew + disc * q_t
+            td = y - q
+            closs = jnp.sum(wgt * td * td) * inv_b
+            # L_c = mean(w * td^2); dL/dq = -2/B * w * td
+            dq = (-2.0 * inv_b) * wgt * td
 
         def critic_bwd(group, acts, a, dq_in, wgrads: bool):
             """Backprop dq through the critic. With wgrads, returns
@@ -275,8 +339,20 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
         # ---- actor forward + backward (through the pre-update critic) ----
         u, (a_acts, t_u) = actor_fwd(actor_o, obs)
         q_pi, pi_acts = critic_fwd(critic_o, obs, u)
-        # dL_a/dq = -1/B
-        dq_pi = jnp.full_like(q_pi, -inv_b)
+        if distributional:
+            # L_a = -mean(E[Z(s, mu(s))]), E[Z] = sum_j softmax(logits)_j z_j.
+            # Softmax jacobian gives the closed-form cotangent:
+            # dL/dlogits_j = -(1/B) * p_j * (z_j - E[Z]).
+            m_pi = jnp.max(q_pi, axis=-1, keepdims=True)
+            e_pi = jnp.exp(q_pi - m_pi)
+            p_pi = e_pi / jnp.sum(e_pi, axis=-1, keepdims=True)
+            q_exp = jnp.sum(p_pi * z, axis=-1, keepdims=True)  # [B, 1]
+            dq_pi = (-inv_b) * p_pi * (z - q_exp)
+            aloss = -jnp.sum(q_exp) * inv_b
+        else:
+            # dL_a/dq = -1/B
+            dq_pi = jnp.full_like(q_pi, -inv_b)
+            aloss = -jnp.sum(q_pi) * inv_b
         _, da = critic_bwd(critic_o, pi_acts, u, dq_pi, wgrads=False)
 
         def actor_bwd(group, acts, t_out, da_in):
@@ -320,8 +396,6 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
 
         # ---- outputs -----------------------------------------------------
         td_out[0] = td
-        closs = jnp.sum(wgt * td * td) * inv_b
-        aloss = -jnp.sum(q_pi) * inv_b
         # Order must match learner.METRIC_KEYS; the wrapper sizes the metric
         # block from len(METRIC_KEYS) and asserts this stack agrees.
         # The chunk MEAN is accumulated in-kernel into a (1, 6) output whose
@@ -374,9 +448,9 @@ def make_fused_chunk_fn(
     layout); callers gather it from replay storage however they like."""
     if not supported(config):
         raise ValueError(
-            "fused chunk kernel supports the classic DDPG envelope only: "
-            "distributional=False, action_insert_layer=1, critic_l2=0, "
-            "fused_update=False, >=2 critic hidden layers, >=1 actor hidden"
+            "fused chunk kernel envelope: action_insert_layer=1, "
+            "critic_l2=0, fused_update=False, >=2 critic hidden layers, "
+            ">=1 actor hidden, num_atoms<=256 when distributional"
         )
     if not fits_vmem(config, obs_dim, act_dim):
         raise ValueError(
@@ -394,6 +468,13 @@ def make_fused_chunk_fn(
     )
     offset = jnp.broadcast_to(
         jnp.asarray(action_offset, jnp.float32), (1, a)
+    )
+    z_row = (
+        jnp.linspace(
+            config.v_min, config.v_max, config.num_atoms, dtype=jnp.float32
+        ).reshape(1, -1)
+        if config.distributional
+        else None
     )
 
     from distributed_ddpg_tpu.learner import METRIC_KEYS
@@ -437,6 +518,7 @@ def make_fused_chunk_fn(
             + [stream_spec(o), stream_spec(a), stream_spec(1), stream_spec(1),
                stream_spec(o), stream_spec(1)]
             + [pinned_spec(scale), pinned_spec(offset)]
+            + ([pinned_spec(z_row)] if z_row is not None else [])
             + [pinned_spec(x) for x in state_flat]
         )
         out_specs = (
@@ -466,6 +548,7 @@ def make_fused_chunk_fn(
         count0 = jnp.stack(
             [state.actor_opt.count, state.critic_opt.count]
         ).astype(jnp.int32)
+        support_args = (z_row,) if z_row is not None else ()
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
@@ -473,7 +556,10 @@ def make_fused_chunk_fn(
             out_specs=out_specs,
             out_shape=out_shape,
             interpret=interp,
-        )(count0, obs, act, rew, disc, nobs, wgt, scale, offset, *state_flat)
+        )(
+            count0, obs, act, rew, disc, nobs, wgt, scale, offset,
+            *support_args, *state_flat,
+        )
 
         td = outs[0][..., 0]
         met = outs[1][0]

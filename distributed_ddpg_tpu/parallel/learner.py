@@ -261,10 +261,9 @@ class ShardedLearner:
                 "fused_chunk='on' but the config/mesh is outside the kernel "
                 "envelope: needs mode='auto', a single-device or data-only "
                 "mesh (model_axis == 1, and fused_mesh != 'off' for "
-                "multi-device), plus distributional=False, "
-                "action_insert_layer=1, critic_l2=0, fused_update=False, "
-                "compute_dtype='float32', >=2 critic hidden layers, and "
-                "nets small enough for VMEM (ops/fused_chunk.fits_vmem)"
+                "multi-device), plus action_insert_layer=1, critic_l2=0, "
+                "fused_update=False, >=2 critic hidden layers, and nets "
+                "small enough for VMEM (ops/fused_chunk.fits_vmem)"
             )
         scan_sample_chunk_fn = sample_chunk_fn
         if self.fused_chunk_active and not self.fused_mesh_active:

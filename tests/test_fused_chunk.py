@@ -59,6 +59,43 @@ def test_fused_chunk_matches_scan(hidden, scale, offset):
     )
 
 
+def test_fused_chunk_c51_matches_scan():
+    """D4PG envelope: the in-kernel categorical projection (triangular-
+    kernel accumulation) + closed-form CE/expected-value cotangents must
+    reproduce the autodiff scan path at bit-oracle tolerances."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 24, 16), batch_size=B,
+        distributional=True, num_atoms=21, v_min=-5.0, v_max=5.0, seed=3,
+    )
+    assert fused_chunk.supported(cfg)
+    assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 1.5, 0.25,
+        interpret=True, rtol=2e-4, atol=1e-5, metric_rtol=5e-4,
+    )
+
+
+@pytest.mark.parametrize("distributional", [False, True])
+def test_fused_chunk_bf16_matches_scan(distributional):
+    """Mixed precision: the kernel's bf16-operand/f32-accumulate dots must
+    track the scan path's (models/mlp._dense) within bf16 rounding — the
+    two differ only in where autodiff inserts the casts on the backward
+    pass, so tolerances are bf16-level, not bit-level."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B,
+        compute_dtype="bfloat16", distributional=distributional,
+        num_atoms=21, v_min=-5.0, v_max=5.0, seed=3,
+    )
+    assert fused_chunk.supported(cfg)
+    assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 2.0, 0.0,
+        interpret=True, rtol=3e-2, atol=3e-3, metric_rtol=3e-2,
+    )
+
+
 def test_sharded_learner_fused_path_matches_scan_path():
     """On a 1-device mesh, fused_chunk='on' must reproduce fused_chunk='off'
     through the public run_sample_chunk API: both draw the same (K, B) index
@@ -155,20 +192,25 @@ def test_fused_chunk_on_requires_envelope():
 
     with pytest.raises(ValueError):
         ShardedLearner(
-            DDPGConfig(distributional=True, fused_chunk="on"),
+            DDPGConfig(critic_l2=1e-4, fused_chunk="on"),
             OBS, ACT, action_scale=1.0,
             mesh=make_mesh(1, 1, devices=jax.devices()[:1]),
         )
 
 
 def test_supported_gates():
-    assert not fused_chunk.supported(DDPGConfig(distributional=True))
+    # D4PG (C51) and bf16 are INSIDE the envelope since round 4.
+    assert fused_chunk.supported(DDPGConfig(distributional=True))
+    assert fused_chunk.supported(DDPGConfig(compute_dtype="bfloat16"))
+    assert not fused_chunk.supported(
+        DDPGConfig(distributional=True, num_atoms=512)  # unroll cap
+    )
     assert not fused_chunk.supported(DDPGConfig(critic_l2=1e-4))
     assert not fused_chunk.supported(DDPGConfig(action_insert_layer=0))
     assert not fused_chunk.supported(DDPGConfig(critic_hidden=(32,)))
     with pytest.raises(ValueError):
         fused_chunk.make_fused_chunk_fn(
-            DDPGConfig(distributional=True), OBS, ACT, 1.0
+            DDPGConfig(critic_l2=1e-4), OBS, ACT, 1.0
         )
     # VMEM budget gate: huge nets fall back to the XLA scan path.
     big = DDPGConfig(actor_hidden=(1024, 1024), critic_hidden=(1024, 1024))

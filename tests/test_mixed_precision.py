@@ -61,7 +61,8 @@ def test_bf16_gates():
         DDPGConfig(compute_dtype="fp16")
     with pytest.raises(ValueError, match="bit-comparability"):
         DDPGConfig(compute_dtype="bfloat16", backend="native")
-    # The f32-only pallas megakernel must decline bf16 configs.
+    # The megakernel admits bf16 since round 4 (bf16 dots, f32 accumulate);
+    # parity is pinned in tests/test_fused_chunk.py::test_fused_chunk_bf16_*.
     from distributed_ddpg_tpu.ops import fused_chunk
 
-    assert not fused_chunk.supported(DDPGConfig(compute_dtype="bfloat16"))
+    assert fused_chunk.supported(DDPGConfig(compute_dtype="bfloat16"))

@@ -70,6 +70,21 @@ def test_fused_kernel_native_parity(tpu):
     assert out["ok"]
 
 
+def test_fused_kernel_native_parity_c51(tpu):
+    """The D4PG (C51) kernel branch — in-kernel categorical projection and
+    closed-form cotangents — must compile under real Mosaic and match the
+    scan path."""
+    out = _run_child("fused_parity_c51")
+    assert out["ok"]
+
+
+def test_fused_kernel_native_parity_bf16(tpu):
+    """The bf16 kernel (MXU-rate dots, f32 accumulate) must compile under
+    real Mosaic and track the bf16 scan path within rounding."""
+    out = _run_child("fused_parity_bf16")
+    assert out["ok"]
+
+
 def test_device_replay_ingest_and_sample_chunk(tpu):
     """Real h2d DeviceReplay ingest + the production run_sample_chunk
     dispatch; fused_chunk='auto' must actually activate on real TPU (if it

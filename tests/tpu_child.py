@@ -114,9 +114,51 @@ def _sample_chunk() -> dict:
     }
 
 
+def _fused_parity_c51() -> dict:
+    """Native Mosaic compile + parity for the D4PG (C51) kernel branch —
+    the in-kernel categorical projection and closed-form cotangents."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.ops import fused_chunk
+
+    assert fused_chunk.runs_native(), "needs a native TPU backend"
+    cfg = DDPGConfig(
+        actor_hidden=(256, 256), critic_hidden=(256, 256), batch_size=B,
+        distributional=True, num_atoms=51, v_min=-150.0, v_max=150.0, seed=3,
+    )
+    metrics = assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 1.0, 0.0,
+        interpret=None, rtol=2e-2, atol=1e-2,
+    )
+    return {"ok": True, "critic_loss": float(metrics["critic_loss"])}
+
+
+def _fused_parity_bf16() -> dict:
+    """Native bf16 megakernel (MXU-rate dots, f32 accumulate) vs the bf16
+    scan path — bf16-rounding tolerances."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.ops import fused_chunk
+
+    assert fused_chunk.runs_native(), "needs a native TPU backend"
+    cfg = DDPGConfig(
+        actor_hidden=(256, 256), critic_hidden=(256, 256), batch_size=B,
+        compute_dtype="bfloat16", seed=3,
+    )
+    metrics = assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 1.0, 0.0,
+        interpret=None, rtol=5e-2, atol=2e-2,
+    )
+    return {"ok": True, "critic_loss": float(metrics["critic_loss"])}
+
+
 CASES = {
     "probe": _probe,
     "fused_parity": _fused_parity,
+    "fused_parity_c51": _fused_parity_c51,
+    "fused_parity_bf16": _fused_parity_bf16,
     "sample_chunk": _sample_chunk,
 }
 
