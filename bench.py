@@ -350,26 +350,39 @@ def phase_study() -> dict:
 
     _assert_platform()
     seconds = float(os.environ.get("BENCH_SECONDS", "6"))
+    base = _config()
+    grid = [
+        (f"b{b}_{'fused' if m == 'auto' else 'scan'}",
+         base.replace(batch_size=b, fused_chunk=m))
+        for b in (64, 256, 1024)
+        for m in ("auto", "off")
+    ] + [
+        # Round-4 kernel envelope extensions at the flagship batch: D4PG
+        # (C51 in-kernel) and bf16 (MXU-rate dots) vs their scan paths.
+        (f"{tag}_{'fused' if m == 'auto' else 'scan'}",
+         base.replace(fused_chunk=m, **kw))
+        for tag, kw in (
+            ("d4pg", dict(distributional=True, num_atoms=51,
+                          v_min=-150.0, v_max=150.0)),
+            ("bf16", dict(compute_dtype="bfloat16")),
+        )
+        for m in ("auto", "off")
+    ]
     points = {}
-    for batch in (64, 256, 1024):
-        for mode in ("auto", "off"):
-            key = f"b{batch}_{'fused' if mode == 'auto' else 'scan'}"
-            # Per-point isolation: one failing point (e.g. the kernel at a
-            # batch far outside its tuned envelope) must not discard the
-            # rest of the grid.
-            try:
-                config = _config().replace(
-                    batch_size=batch, fused_chunk=mode
-                )
-                replay = _fill_replay(config, n=40_000)
-                r = _measure_jax(config, replay, seconds)
-                points[key] = {
-                    "grad_steps_per_sec": round(r["rate"], 1),
-                    "fused_chunk_active": r["fused_chunk_active"],
-                    **({"mfu": round(r["mfu"], 5)} if "mfu" in r else {}),
-                }
-            except Exception as e:
-                points[key] = {"error": repr(e)[:300]}
+    for key, config in grid:
+        # Per-point isolation: one failing point (e.g. the kernel at a
+        # batch far outside its tuned envelope) must not discard the
+        # rest of the grid.
+        try:
+            replay = _fill_replay(config, n=40_000)
+            r = _measure_jax(config, replay, seconds)
+            points[key] = {
+                "grad_steps_per_sec": round(r["rate"], 1),
+                "fused_chunk_active": r["fused_chunk_active"],
+                **({"mfu": round(r["mfu"], 5)} if "mfu" in r else {}),
+            }
+        except Exception as e:
+            points[key] = {"error": repr(e)[:300]}
     return {"study": points}
 
 
