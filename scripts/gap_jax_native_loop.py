@@ -1,0 +1,118 @@
+"""Gap-attribution experiment: the NATIVE loop shape, the JAX learner.
+
+Reruns train_native's exact topology — one env, one smoothly-updating
+acting policy (no workers, no transport, no chunking, no prefetch: act,
+step env, insert, sample ONE batch, ONE gradient step, every env step) —
+but with the jitted JAX learner instead of the numpy one, and the JAX
+actor driving the env. Together with the earlier legs this splits the last
+two candidate causes of the native-vs-jax return gap:
+
+  - lands ~native (≈1100 @150k): the tight per-step loop topology itself
+    is what plateaus; the jax pipeline's chunked/prefetched asynchrony is
+    load-bearing for return, and the native learner is exonerated.
+  - lands ~jax (≈3700-4700 @150k): the two learner implementations behave
+    differently on real-scale data despite the synthetic-batch trajectory
+    parity tests — a numerics investigation follows.
+
+Usage: python scripts/gap_jax_native_loop.py [steps] [seed]
+Writes runs/r4_gap_jaxlearner_nativeloop.jsonl.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    total = int(sys.argv[1]) if len(sys.argv) > 1 else 150_000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.envs import make, spec_of
+    from distributed_ddpg_tpu.learner import init_train_state, jit_learner_step
+    from distributed_ddpg_tpu.metrics import MetricsLogger
+    from distributed_ddpg_tpu.models.mlp import actor_apply
+    from distributed_ddpg_tpu.ops.noise import OUNoise
+    from distributed_ddpg_tpu.replay import UniformReplay
+    from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+    from distributed_ddpg_tpu.train import _eval_numpy
+    from distributed_ddpg_tpu.types import batch_from_numpy
+
+    config = DDPGConfig(
+        env_id="HalfCheetah-v4", seed=seed, total_env_steps=total,
+        eval_every=30_000, eval_episodes=3,
+    )
+    env = make(config.env_id, seed=config.seed)
+    spec = spec_of(env)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state = init_train_state(config, spec.obs_dim, spec.act_dim, config.seed)
+    jstep = jit_learner_step(
+        config, spec.action_scale, donate=False,
+        action_offset=spec.action_offset,
+    )
+    # Jitted single-obs actor forward (the acting policy; always-current
+    # params, exactly train_native's coupling).
+    fwd = jax.jit(
+        lambda p, o: actor_apply(p, o, spec.action_scale, spec.action_offset)
+    )
+
+    def act(obs):
+        return np.asarray(fwd(state.actor_params, np.atleast_2d(obs)))[0]
+
+    replay = UniformReplay(
+        config.replay_capacity, spec.obs_dim, spec.act_dim, seed=config.seed
+    )
+    noise = OUNoise(
+        (spec.act_dim,), config.ou_theta, config.ou_sigma, dt=config.ou_dt,
+        seed=config.seed + 1,
+    )
+    nstep = NStepAccumulator(config.n_step, config.gamma)
+    log = MetricsLogger(
+        os.path.join(REPO, "runs", "r4_gap_jaxlearner_nativeloop.jsonl")
+    )
+
+    def eval_policy(obs):
+        return np.asarray(fwd(state.actor_params, np.atleast_2d(obs)))
+
+    obs, _ = env.reset(seed=config.seed)
+    min_fill = max(config.replay_min_size, config.batch_size)
+    learn_steps = 0
+    for step in range(1, total + 1):
+        a = act(obs) + noise() * spec.action_scale
+        a = np.clip(a, spec.action_low, spec.action_high).astype(np.float32)
+        next_obs, reward, terminated, truncated, _ = env.step(a)
+        for tr in nstep.push(
+            obs[None], a[None], [reward], [terminated], next_obs[None]
+        ):
+            replay.add(*tr)
+        obs = next_obs
+        if terminated or truncated:
+            obs, _ = env.reset()
+            noise.reset()
+            nstep.reset()
+        if len(replay) >= min_fill:
+            sample = replay.sample(config.batch_size)
+            sample.pop("indices")
+            out = jstep(state, batch_from_numpy(sample))
+            state = out.state
+            learn_steps += 1
+        if step % config.eval_every == 0:
+            ret = _eval_numpy(eval_policy, config, spec)
+            log.log("eval", step, eval_return=ret)
+            print(f"step {step} eval {ret:.1f}", flush=True)
+    ret = _eval_numpy(eval_policy, config, spec)
+    log.log("final", total, final_return=ret, learner_steps=learn_steps)
+    log.close()
+    print(f"FINAL jax-learner-native-loop: {ret:.1f}")
+
+
+if __name__ == "__main__":
+    main()

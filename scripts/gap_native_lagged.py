@@ -1,0 +1,128 @@
+"""Gap-attribution experiment (docs/EVIDENCE.md §7): the native loop with a
+LAGGED acting policy.
+
+The native-vs-jax return gap survives controls for actor count, seed,
+transport backlog, and replay implementation; the one structural variable
+left between the two data streams is behavior-policy lag — the jax actors
+act on params that trail the learner by the transport/refresh pipeline
+depth (~224-4100 learner steps, measured), while the native loop acts on
+params updated after EVERY gradient step (lag 0).
+
+This script reruns the exact native loop (same NativeLearner, OU noise,
+n-step accumulator, uniform replay, eval) but acts from a SNAPSHOT of the
+actor params refreshed every `lag` learner steps. lag=0 reproduces
+train_native; lag>=~200 reproduces the jax pipeline's behavior stream. If
+the lagged native run recovers the jax-side returns, the gap is the lag
+(an architectural regularizer the async pipeline provides for free), not
+backend math — completing the attribution VERDICT r3 Next #7 asks for.
+
+Usage: python scripts/gap_native_lagged.py <lag> [steps] [seed]
+Writes runs/r4_gap_native_lag<lag>.jsonl.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    lag = int(sys.argv[1])
+    total = int(sys.argv[2]) if len(sys.argv) > 2 else 150_000
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.envs import make, spec_of
+    from distributed_ddpg_tpu.learner import init_train_state
+    from distributed_ddpg_tpu.metrics import MetricsLogger
+    from distributed_ddpg_tpu.native_backend import NativeLearner
+    from distributed_ddpg_tpu.ops.noise import OUNoise
+    from distributed_ddpg_tpu.replay import UniformReplay
+    from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+    from distributed_ddpg_tpu.train import _eval_numpy
+
+    config = DDPGConfig(
+        env_id="HalfCheetah-v4", seed=seed, total_env_steps=total,
+        eval_every=30_000, eval_episodes=3,
+    )
+    env = make(config.env_id, seed=config.seed)
+    spec = spec_of(env)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state = init_train_state(config, spec.obs_dim, spec.act_dim, config.seed)
+    learner = NativeLearner(config, state, spec.action_scale, spec.action_offset)
+    replay = UniformReplay(
+        config.replay_capacity, spec.obs_dim, spec.act_dim, seed=config.seed
+    )
+    noise = OUNoise(
+        (spec.act_dim,), config.ou_theta, config.ou_sigma, dt=config.ou_dt,
+        seed=config.seed + 1,
+    )
+    nstep = NStepAccumulator(config.n_step, config.gamma)
+    log = MetricsLogger(
+        os.path.join(REPO, "runs", f"r4_gap_native_lag{lag}.jsonl")
+    )
+
+    # The acting policy: a frozen copy of the actor params, refreshed every
+    # `lag` learner steps (lag=0 -> act on the live params, as train_native
+    # does). Deep-copy so Adam's in-place updates don't leak through.
+    def snapshot():
+        return [
+            {k: v.copy() for k, v in layer.items()} for layer in learner.actor
+        ]
+
+    acting = snapshot() if lag else None
+    last_refresh = 0
+
+    def act(obs):
+        if lag == 0:
+            return learner.act(obs)[0]
+        x = np.atleast_2d(obs)
+        for layer in acting[:-1]:
+            x = np.maximum(x @ layer["w"] + layer["b"], 0.0)
+        z = x @ acting[-1]["w"] + acting[-1]["b"]
+        return (np.tanh(z) * learner.scale + learner.offset)[0]
+
+    obs, _ = env.reset(seed=config.seed)
+    learn_steps = 0
+    min_fill = max(config.replay_min_size, config.batch_size)
+    for step in range(1, total + 1):
+        a = act(obs) + noise() * spec.action_scale
+        a = np.clip(a, spec.action_low, spec.action_high).astype(np.float32)
+        next_obs, reward, terminated, truncated, _ = env.step(a)
+        for tr in nstep.push(
+            obs[None], a[None], [reward], [terminated], next_obs[None]
+        ):
+            replay.add(*tr)
+        obs = next_obs
+        if terminated or truncated:
+            obs, _ = env.reset()
+            noise.reset()
+            nstep.reset()
+        if len(replay) >= min_fill:
+            sample = replay.sample(config.batch_size)
+            sample.pop("indices")
+            learner.step(sample)
+            learn_steps += 1
+            if lag and learn_steps - last_refresh >= lag:
+                acting[:] = snapshot()
+                last_refresh = learn_steps
+        if step % config.eval_every == 0:
+            ret = _eval_numpy(learner.act, config, spec)
+            log.log("eval", step, eval_return=ret, lag=lag)
+            print(f"step {step} eval {ret:.1f}", flush=True)
+    final = _eval_numpy(learner.act, config, spec)
+    log.log("final", total, final_return=final, lag=lag,
+            learner_steps=learn_steps)
+    log.close()
+    print(f"FINAL lag={lag}: {final:.1f}")
+
+
+if __name__ == "__main__":
+    main()

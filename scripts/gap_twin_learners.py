@@ -1,0 +1,133 @@
+"""Gap-attribution experiment: twin learners on the SAME data stream.
+
+One env loop, driven by the NATIVE learner's policy (+OU noise), feeds one
+replay buffer. At every env step BOTH learners take one gradient step on
+batches drawn from that shared buffer — the native numpy learner and the
+jitted JAX learner — each with its own sampling RNG. Both actors are
+evaluated at the same checkpoints.
+
+This removes every data-stream variable at once (actor count, lag, ring,
+replay impl, noise stream, behavior policy): the two learners see the same
+replay distribution at every step.
+
+OUTCOME (runs/r4_gap_twin.jsonl, 75k steps): CONFOUNDED — the non-driving
+learner's actor is evaluated zero-shot off its own state distribution
+(native 797 vs jax -170 @75k says nothing about learner quality; the
+passenger policy never collects its own data). Kept for the negative
+result; the clean split came from scripts/gap_jax_native_loop.py (the jax
+learner DRIVING the native per-step loop: 1490 @150k — native territory)
+plus the `learner_chunk=1` pipeline run. See docs/EVIDENCE.md §7.
+
+Usage: python scripts/gap_twin_learners.py [steps] [seed] [shared_batches]
+  shared_batches=1: both learners train on the IDENTICAL sampled batch
+  each step (removes sampling RNG too; default 0 = independent draws).
+Writes runs/r4_gap_twin.jsonl.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    total = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    shared = bool(int(sys.argv[3])) if len(sys.argv) > 3 else False
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.envs import make, spec_of
+    from distributed_ddpg_tpu.learner import init_train_state, jit_learner_step
+    from distributed_ddpg_tpu.metrics import MetricsLogger
+    from distributed_ddpg_tpu.native_backend import NativeLearner
+    from distributed_ddpg_tpu.ops.noise import OUNoise
+    from distributed_ddpg_tpu.replay import UniformReplay
+    from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+    from distributed_ddpg_tpu.train import _eval_numpy
+    from distributed_ddpg_tpu.types import batch_from_numpy
+
+    config = DDPGConfig(
+        env_id="HalfCheetah-v4", seed=seed, total_env_steps=total,
+        eval_every=25_000, eval_episodes=3,
+    )
+    env = make(config.env_id, seed=config.seed)
+    spec = spec_of(env)
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        state0 = init_train_state(config, spec.obs_dim, spec.act_dim, config.seed)
+    native = NativeLearner(config, state0, spec.action_scale, spec.action_offset)
+    jstate = state0
+    jstep = jit_learner_step(
+        config, spec.action_scale, donate=False,
+        action_offset=spec.action_offset,
+    )
+
+    replay = UniformReplay(
+        config.replay_capacity, spec.obs_dim, spec.act_dim, seed=config.seed
+    )
+    replay_j = replay if shared else UniformReplay(
+        config.replay_capacity, spec.obs_dim, spec.act_dim, seed=config.seed + 99
+    )
+    noise = OUNoise(
+        (spec.act_dim,), config.ou_theta, config.ou_sigma, dt=config.ou_dt,
+        seed=config.seed + 1,
+    )
+    nstep = NStepAccumulator(config.n_step, config.gamma)
+    log = MetricsLogger(os.path.join(REPO, "runs", "r4_gap_twin.jsonl"))
+
+    def jax_actor_policy(obs):
+        from distributed_ddpg_tpu.models.mlp import actor_apply
+
+        return np.asarray(
+            actor_apply(
+                jstate.actor_params, np.atleast_2d(obs).astype(np.float32),
+                spec.action_scale, spec.action_offset,
+            )
+        )
+
+    obs, _ = env.reset(seed=config.seed)
+    min_fill = max(config.replay_min_size, config.batch_size)
+    for step in range(1, total + 1):
+        a = native.act(obs)[0] + noise() * spec.action_scale
+        a = np.clip(a, spec.action_low, spec.action_high).astype(np.float32)
+        next_obs, reward, terminated, truncated, _ = env.step(a)
+        for tr in nstep.push(
+            obs[None], a[None], [reward], [terminated], next_obs[None]
+        ):
+            replay.add(*tr)
+            if not shared:
+                replay_j.add(*tr)
+        obs = next_obs
+        if terminated or truncated:
+            obs, _ = env.reset()
+            noise.reset()
+            nstep.reset()
+        if len(replay) >= min_fill:
+            sample = replay.sample(config.batch_size)
+            sample.pop("indices")
+            native.step(sample)
+            if not shared:
+                sample = replay_j.sample(config.batch_size)
+                sample.pop("indices")
+            out = jstep(jstate, batch_from_numpy(sample))
+            jstate = out.state
+        if step % config.eval_every == 0:
+            rn = _eval_numpy(native.act, config, spec)
+            rj = _eval_numpy(jax_actor_policy, config, spec)
+            log.log("eval", step, eval_native=rn, eval_jax=rj, shared=shared)
+            print(f"step {step} native {rn:.1f} jax {rj:.1f}", flush=True)
+    rn = _eval_numpy(native.act, config, spec)
+    rj = _eval_numpy(jax_actor_policy, config, spec)
+    log.log("final", total, eval_native=rn, eval_jax=rj, shared=shared)
+    log.close()
+    print(f"FINAL native {rn:.1f} jax {rj:.1f}")
+
+
+if __name__ == "__main__":
+    main()
