@@ -49,9 +49,9 @@ def child(pid: int, nprocs: int, port: int, budget: int) -> None:
         actor_hidden=(256, 256),
         critic_hidden=(256, 256),
         batch_size=64,
-        num_actors=8,            # 8 per process = 16 actors total
+        num_actors=2,            # 2 per process = 4 actors total (1-core host)
         total_env_steps=budget,  # GLOBAL budget, summed over processes
-        replay_min_size=2000,
+        replay_min_size=1000,
         replay_capacity=200_000,
         eval_every=max(budget // 4, 1),
         eval_episodes=1,
