@@ -38,6 +38,7 @@ COMPAT_FIELDS = (
     "critic_hidden",
     "action_insert_layer",
     "distributional",
+    "twin_critic",  # rank-3 ensemble critic leaves vs rank-2 plain ones
     "num_atoms",
     "v_min",
     "v_max",

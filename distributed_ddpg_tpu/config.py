@@ -41,6 +41,20 @@ class DDPGConfig:
     v_min: float = -150.0
     v_max: float = 150.0
 
+    # --- TD3 (arXiv 1802.09477; beyond-parity family like D4PG) ---
+    # twin_critic: a 2-critic ensemble (params stacked on a leading axis,
+    # applied via vmap — one MXU-batched program, not two sequential nets),
+    # with min-over-ensemble Bellman targets (clipped double-Q).
+    twin_critic: bool = False
+    # Actor + target nets update once per `policy_delay` critic steps.
+    policy_delay: int = 1
+    # Target-policy smoothing: clip(N(0, target_noise), +-clip) added to
+    # the target action inside the critic target (0 = off). The noise key
+    # derives from fold_in(seed, state.step) — deterministic, replayable,
+    # and identical across data-parallel replicas.
+    target_noise: float = 0.0
+    target_noise_clip: float = 0.5
+
     # --- replay (SURVEY.md §2 #5/#7) ---
     replay_capacity: int = 1_000_000
     replay_min_size: int = 1_000     # warmup before learning starts
@@ -210,6 +224,33 @@ class DDPGConfig:
         if self.fused_mesh not in ("auto", "off"):
             raise ValueError(
                 f"fused_mesh must be 'auto' or 'off', got {self.fused_mesh!r}"
+            )
+        if self.policy_delay < 1:
+            raise ValueError("policy_delay must be >= 1")
+        if self.target_noise < 0 or self.target_noise_clip < 0:
+            raise ValueError("target_noise/target_noise_clip must be >= 0")
+        if not self.twin_critic and (
+            self.policy_delay > 1 or self.target_noise > 0
+        ):
+            raise ValueError(
+                "policy_delay/target_noise are TD3 knobs consumed only by "
+                "the twin-critic step — set twin_critic=True or they would "
+                "silently do nothing"
+            )
+        if self.twin_critic and self.distributional:
+            raise ValueError(
+                "twin_critic (TD3) and distributional (D4PG) are separate "
+                "algorithm families; enable one"
+            )
+        if self.twin_critic and self.fused_update:
+            raise ValueError(
+                "twin_critic composes with the stock Adam+Polyak tree update"
+                " (delayed via lax.cond), not the fused_update kernel"
+            )
+        if self.twin_critic and self.backend == "native":
+            raise ValueError(
+                "twin_critic requires a JAX backend: the native numpy "
+                "learner is the plain-DDPG bit-comparability oracle"
             )
         if self.max_ingest_ratio < 0:
             raise ValueError("max_ingest_ratio must be >= 0 (0 = unlimited)")

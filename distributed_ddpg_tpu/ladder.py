@@ -56,7 +56,12 @@ RUNGS: Dict[int, DDPGConfig] = {
     ),
     3: DDPGConfig(
         env_id="BipedalWalker-v3", backend="jax_tpu", num_actors=8,
-        prioritized=True, total_env_steps=1_000_000, **_GATED,
+        prioritized=True, total_env_steps=1_000_000,
+        # n-step 3: vanilla (1-step) plateaus at 74 final / eval peak 141
+        # over 1M steps; 3-step credit assignment SOLVES the env — eval 301
+        # by 400k, final 293 at 600k (runs/r4_rung3_nstep3.jsonl). BASELINE
+        # pins env/actors/PER for this rung, not the return horizon.
+        n_step=3, **_GATED,
     ),
     4: DDPGConfig(
         env_id="HalfCheetah-v4", backend="jax_tpu", num_actors=16,

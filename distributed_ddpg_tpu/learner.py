@@ -67,14 +67,32 @@ def init_train_state(config: DDPGConfig, obs_dim: int, act_dim: int, seed: int) 
     k_actor, k_critic = jax.random.split(key)
     num_outputs = config.num_atoms if config.distributional else 1
     actor_params = actor_init(k_actor, obs_dim, act_dim, tuple(config.actor_hidden))
-    critic_params = critic_init(
-        k_critic,
-        obs_dim,
-        act_dim,
-        tuple(config.critic_hidden),
-        config.action_insert_layer,
-        num_outputs,
-    )
+    if config.twin_critic:
+        # TD3 ensemble: two independently-initialized critics stacked on a
+        # leading axis — the TrainState SHAPE is unchanged (same tree, each
+        # critic leaf just gains a [2, ...] dim), so checkpointing, Adam,
+        # Polyak, and the mesh pspec trees all compose without new cases.
+        k1, k2 = jax.random.split(k_critic)
+        critic_params = jax.tree.map(
+            lambda a, b: jnp.stack([a, b]),
+            critic_init(
+                k1, obs_dim, act_dim, tuple(config.critic_hidden),
+                config.action_insert_layer, num_outputs,
+            ),
+            critic_init(
+                k2, obs_dim, act_dim, tuple(config.critic_hidden),
+                config.action_insert_layer, num_outputs,
+            ),
+        )
+    else:
+        critic_params = critic_init(
+            k_critic,
+            obs_dim,
+            act_dim,
+            tuple(config.critic_hidden),
+            config.action_insert_layer,
+            num_outputs,
+        )
     return TrainState(
         actor_params=actor_params,
         critic_params=critic_params,
@@ -115,10 +133,45 @@ def make_learner_step(
         if config.distributional
         else None
     )
+    # TD3 target-smoothing noise: keyed by fold_in(seed-derived base, step)
+    # — no key threads through the step signature, the stream is
+    # deterministic/replayable, and every data-parallel replica derives the
+    # identical key (replicated state.step), so replicas cannot fork.
+    td3_base_key = (
+        jax.random.PRNGKey(config.seed ^ 0x7D3AF)
+        if config.twin_critic
+        else None
+    )
 
     def step(state: TrainState, batch: Batch) -> StepOutput:
         # --- critic update ---
-        if config.distributional:
+        if config.twin_critic:
+            noise_key = jax.random.fold_in(td3_base_key, state.step)
+            if axis_name is not None:
+                # Explicit shard_map mode: each shard smooths its OWN batch
+                # slice — without this fold every shard would draw the
+                # identical eps matrix and a global batch of B*D rows would
+                # get only B unique perturbations.
+                noise_key = jax.random.fold_in(
+                    noise_key, jax.lax.axis_index(axis_name)
+                )
+
+            def critic_loss_fn(cp):
+                return losses.td3_critic_loss(
+                    cp,
+                    state.target_actor_params,
+                    state.target_critic_params,
+                    batch,
+                    scale,
+                    noise_key,
+                    config.target_noise,
+                    config.target_noise_clip,
+                    ail,
+                    config.critic_l2,
+                    offset,
+                    mm,
+                )
+        elif config.distributional:
             def critic_loss_fn(cp):
                 return losses.distributional_critic_loss(
                     cp,
@@ -151,7 +204,12 @@ def make_learner_step(
         cgrads = _maybe_psum_mean(cgrads, axis_name)
 
         # --- actor update (pre-update critic: both grads from the same state) ---
-        if config.distributional:
+        if config.twin_critic:
+            def actor_loss_fn(ap):
+                return losses.td3_actor_loss(
+                    ap, state.critic_params, batch, scale, ail, offset, mm
+                )
+        elif config.distributional:
             def actor_loss_fn(ap):
                 return losses.distributional_actor_loss(
                     ap, state.critic_params, batch, scale, support, ail, offset, mm
@@ -162,10 +220,63 @@ def make_learner_step(
                     ap, state.critic_params, batch, scale, ail, offset, mm
                 )
 
-        aloss, agrads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
-        agrads = _maybe_psum_mean(agrads, axis_name)
+        if config.twin_critic and config.policy_delay > 1:
+            # TD3 delayed updates: the critic steps every call; the actor
+            # AND both target nets step once per policy_delay critic steps
+            # (lax.cond — both branches return the same pytree structure,
+            # so the step stays a single traced program). The actor
+            # BACKWARD (and its gradient pmean) lives inside the update
+            # branch so skipped steps pay only the cheap forward for the
+            # aloss metric — not (d-1)/d of wasted bwd FLOPs per chunk.
+            # The cond predicate is the replicated state.step, so every
+            # replica takes the same branch and the collective schedule
+            # stays aligned. actor_opt.count only advances on real
+            # updates, keeping Adam bias correction honest; updates land
+            # on critic steps 0, d, 2d, ... (pre-increment step).
+            aloss = actor_loss_fn(state.actor_params)
+            new_critic, critic_opt = adam_update(
+                state.critic_params, cgrads, state.critic_opt, config.critic_lr
+            )
 
-        if config.fused_update:
+            def _delayed_update(_):
+                agrads = jax.grad(actor_loss_fn)(state.actor_params)
+                agrads = _maybe_psum_mean(agrads, axis_name)
+                na, aopt = adam_update(
+                    state.actor_params, agrads, state.actor_opt, config.actor_lr
+                )
+                return (
+                    na,
+                    aopt,
+                    polyak_update(na, state.target_actor_params, config.tau),
+                    polyak_update(
+                        new_critic, state.target_critic_params, config.tau
+                    ),
+                    optree_norm(agrads),
+                )
+
+            def _skip_update(_):
+                # actor_grad_norm reads 0 on skip steps (no grad computed).
+                return (
+                    state.actor_params,
+                    state.actor_opt,
+                    state.target_actor_params,
+                    state.target_critic_params,
+                    jnp.zeros((), jnp.float32),
+                )
+
+            (
+                new_actor, actor_opt, new_target_actor, new_target_critic,
+                actor_grad_norm,
+            ) = jax.lax.cond(
+                state.step % config.policy_delay == 0,
+                _delayed_update,
+                _skip_update,
+                operand=None,
+            )
+        elif config.fused_update:
+            aloss, agrads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+            agrads = _maybe_psum_mean(agrads, axis_name)
+            actor_grad_norm = optree_norm(agrads)
             # Pallas kernel: Adam + Polyak in one VPU pass (ops/fused_update.py).
             from distributed_ddpg_tpu.ops.fused_update import fused_adam_polyak
 
@@ -178,6 +289,9 @@ def make_learner_step(
                 state.target_actor_params, config.actor_lr, config.tau,
             )
         else:
+            aloss, agrads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+            agrads = _maybe_psum_mean(agrads, axis_name)
+            actor_grad_norm = optree_norm(agrads)
             new_critic, critic_opt = adam_update(
                 state.critic_params, cgrads, state.critic_opt, config.critic_lr
             )
@@ -198,7 +312,7 @@ def make_learner_step(
                     -aloss,
                     jnp.mean(jnp.abs(td)),
                     optree_norm(cgrads),
-                    optree_norm(agrads),
+                    actor_grad_norm,
                 ),
             )
         )

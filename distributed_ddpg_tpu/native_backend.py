@@ -36,10 +36,11 @@ class NativeLearner:
     """Numpy mirror of learner.make_learner_step for non-distributional DDPG."""
 
     def __init__(self, config: DDPGConfig, state, action_scale, action_offset=0.0):
-        if config.distributional:
+        if config.distributional or config.twin_critic:
             raise NotImplementedError(
                 "--backend native implements the reference's plain-DDPG surface; "
-                "the distributional critic is jax_tpu-only"
+                "the distributional (D4PG) and twin (TD3) critics are "
+                "jax_tpu-only"
             )
         self.config = config
         self.scale = np.asarray(action_scale, np.float32)

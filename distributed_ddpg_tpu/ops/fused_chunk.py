@@ -117,7 +117,8 @@ def fits_vmem(config: DDPGConfig, obs_dim: int, act_dim: int) -> bool:
 
 def supported(config: DDPGConfig) -> bool:
     return (
-        config.action_insert_layer == 1
+        not config.twin_critic  # TD3's ensemble/cond scan path only (for now)
+        and config.action_insert_layer == 1
         and config.critic_l2 == 0.0
         and not config.fused_update
         and config.compute_dtype in ("float32", "bfloat16")

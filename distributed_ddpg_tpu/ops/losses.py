@@ -71,6 +71,79 @@ def actor_loss(
 
 
 # ---------------------------------------------------------------------------
+# Twin critic (TD3, arXiv 1802.09477)
+# ---------------------------------------------------------------------------
+
+
+def td3_critic_loss(
+    critic_params,
+    target_actor_params,
+    target_critic_params,
+    batch: Batch,
+    action_scale,
+    noise_key,
+    noise_std: float,
+    noise_clip: float,
+    action_insert_layer: int = 1,
+    l2: float = 0.0,
+    action_offset=0.0,
+    mm_dtype=None,
+):
+    """Clipped double-Q TD loss: min-over-ensemble Bellman target with
+    target-policy smoothing. `critic_params` leaves carry a leading
+    ensemble axis of 2 (learner.init_train_state stacks them); the apply
+    is vmapped over it — one batched program on the MXU, not two
+    sequential critics. Loss is the MEAN of the two critics' weighted
+    MSEs (lr-invariant vs the sum the paper writes), plus `l2` weight
+    decay over both ensemble members (matching critic_loss). Returns
+    (loss, td_proxy[B]) where the proxy is the ensemble-mean TD error
+    (PER priorities)."""
+    next_action = actor_apply(
+        target_actor_params, batch.next_obs, action_scale, action_offset, mm_dtype
+    )
+    if noise_std > 0.0:
+        eps = jnp.clip(
+            noise_std * jax.random.normal(noise_key, next_action.shape),
+            -noise_clip,
+            noise_clip,
+        )
+        lo = action_offset - action_scale
+        hi = action_offset + action_scale
+        next_action = jnp.clip(next_action + eps, lo, hi)
+    ensemble = lambda p, o, a: jax.vmap(
+        lambda cp: critic_apply(cp, o, a, action_insert_layer, mm_dtype)
+    )(p)
+    next_q = ensemble(target_critic_params, batch.next_obs, next_action)  # [2, B]
+    y = jax.lax.stop_gradient(td_targets(batch, jnp.min(next_q, axis=0)))
+    q = ensemble(critic_params, batch.obs, batch.action)  # [2, B]
+    td = y[None, :] - q
+    loss = jnp.mean(batch.weight[None, :] * jnp.square(td))
+    if l2 > 0.0:
+        loss = loss + l2 * sum(
+            jnp.sum(jnp.square(layer["w"])) for layer in critic_params
+        )
+    return loss, jnp.mean(td, axis=0)
+
+
+def td3_actor_loss(
+    actor_params,
+    critic_params,
+    batch: Batch,
+    action_scale,
+    action_insert_layer: int = 1,
+    action_offset=0.0,
+    mm_dtype=None,
+):
+    """DPG loss through critic 0 only (the TD3 convention)."""
+    action = actor_apply(actor_params, batch.obs, action_scale, action_offset, mm_dtype)
+    q1 = critic_apply(
+        jax.tree.map(lambda x: x[0], critic_params),
+        batch.obs, action, action_insert_layer, mm_dtype,
+    )
+    return -jnp.mean(q1)
+
+
+# ---------------------------------------------------------------------------
 # Distributional critic (D4PG)
 # ---------------------------------------------------------------------------
 

@@ -55,6 +55,12 @@ def _layer_pspec(layer_index: int, num_layers: int, kernel_shape, model_size: in
     layer stays replicated (its output dim is act_dim / 1 / num_atoms —
     tiny and indivisible). Dims that don't divide the model axis stay
     replicated rather than erroring — XLA would pad, we'd rather not."""
+    if len(kernel_shape) == 3:
+        # Ensemble-stacked critic (TD3 twin, learner.init_train_state):
+        # leading [2] axis replicated, TP alternation applied to the inner
+        # (in, out) dims exactly as for a plain critic.
+        inner = _layer_pspec(layer_index, num_layers, kernel_shape[1:], model_size)
+        return {"w": P(None, *inner["w"]), "b": P(None, *inner["b"])}
     in_dim, out_dim = kernel_shape
     if model_size == 1 or layer_index == num_layers - 1:
         return {"w": P(None, None), "b": P(None)}

@@ -1,0 +1,197 @@
+"""TD3 (arXiv 1802.09477; beyond-parity family like D4PG): twin-critic
+ensemble via a stacked leading axis + vmap, min-over-ensemble Bellman
+targets, target-policy smoothing keyed by fold_in(seed, step), and
+delayed actor/target updates under lax.cond."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.learner import init_train_state, jit_learner_step
+from distributed_ddpg_tpu.ops import losses
+from distributed_ddpg_tpu.types import Batch
+
+OBS, ACT, B = 5, 2, 16
+
+
+def _cfg(**kw):
+    base = dict(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B,
+        twin_critic=True, seed=0,
+    )
+    base.update(kw)
+    return DDPGConfig(**base)
+
+
+def _batch(rng):
+    return Batch(
+        obs=jnp.asarray(rng.standard_normal((B, OBS)), jnp.float32),
+        action=jnp.asarray(rng.uniform(-1, 1, (B, ACT)), jnp.float32),
+        reward=jnp.asarray(rng.standard_normal(B), jnp.float32),
+        discount=jnp.full((B,), 0.99, jnp.float32),
+        next_obs=jnp.asarray(rng.standard_normal((B, OBS)), jnp.float32),
+        weight=jnp.ones((B,), jnp.float32),
+    )
+
+
+def test_twin_init_stacks_independent_critics():
+    s = init_train_state(_cfg(), OBS, ACT, seed=0)
+    for layer in s.critic_params:
+        assert layer["w"].shape[0] == 2 and layer["w"].ndim == 3
+        # Independent inits: the two ensemble members must differ.
+        assert not np.allclose(layer["w"][0], layer["w"][1])
+    # Actor unchanged (rank 2).
+    assert s.actor_params[0]["w"].ndim == 2
+
+
+def test_min_over_ensemble_target():
+    """The TD3 target must use min(Q1', Q2'): make the ensemble disagree by
+    a known offset and check the realized target against a hand-computed
+    one through the public loss (td = y - q)."""
+    cfg = _cfg(target_noise=0.0)
+    s = init_train_state(cfg, OBS, ACT, seed=0)
+    # Bias critic 1's output bias far above critic 0: min must pick 0's.
+    biased = list(dict(l) for l in s.critic_params)
+    last = dict(biased[-1])
+    last["b"] = jnp.asarray(s.critic_params[-1]["b"]).at[1].add(100.0)
+    biased[-1] = last
+    target_critic = tuple(biased)
+
+    batch = _batch(np.random.default_rng(0))
+    key = jax.random.PRNGKey(0)
+    _, td = losses.td3_critic_loss(
+        s.critic_params, s.target_actor_params, target_critic, batch,
+        1.0, key, 0.0, 0.5,
+    )
+    # Hand-compute y from member 0 only (the min, since member 1 is +100).
+    from distributed_ddpg_tpu.models.mlp import actor_apply, critic_apply
+
+    na = actor_apply(s.target_actor_params, batch.next_obs, 1.0)
+    q0 = critic_apply(
+        jax.tree.map(lambda x: x[0], target_critic), batch.next_obs, na, 1
+    )
+    y = batch.reward + batch.discount * q0
+    q_on = jnp.stack([
+        critic_apply(
+            jax.tree.map(lambda x: x[i], s.critic_params),
+            batch.obs, batch.action, 1,
+        )
+        for i in (0, 1)
+    ])
+    expect_td = y[None] - q_on
+    np.testing.assert_allclose(
+        np.asarray(td), np.asarray(expect_td.mean(0)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_policy_delay_and_counts():
+    cfg = _cfg(policy_delay=3)
+    s = init_train_state(cfg, OBS, ACT, seed=0)
+    step = jit_learner_step(cfg, 1.0, donate=False)
+    batch = _batch(np.random.default_rng(1))
+    actor_updates = 0
+    prev = np.asarray(s.actor_params[0]["w"]).copy()
+    for i in range(6):
+        out = step(s, batch)
+        s = out.state
+        now = np.asarray(s.actor_params[0]["w"])
+        if not np.array_equal(now, prev):
+            actor_updates += 1
+        prev = now.copy()
+    # Updates at critic steps 0 and 3 (state.step pre-increment % delay).
+    assert actor_updates == 2
+    assert int(s.actor_opt.count) == 2
+    assert int(s.critic_opt.count) == 6
+
+
+def test_target_smoothing_is_deterministic_and_active():
+    cfg_noise = _cfg(target_noise=0.2)
+    cfg_clean = _cfg(target_noise=0.0)
+    s = init_train_state(cfg_noise, OBS, ACT, seed=0)
+    batch = _batch(np.random.default_rng(2))
+    sn = jit_learner_step(cfg_noise, 1.0, donate=False)
+    sc = jit_learner_step(cfg_clean, 1.0, donate=False)
+    out1 = sn(s, batch)
+    out2 = sn(s, batch)
+    # fold_in(seed, step) stream: same state+batch -> identical result.
+    np.testing.assert_array_equal(
+        np.asarray(out1.td_errors), np.asarray(out2.td_errors)
+    )
+    # Noise actually perturbs the target (vs the clean config).
+    clean = sc(s, batch)
+    assert not np.allclose(
+        np.asarray(out1.td_errors), np.asarray(clean.td_errors)
+    )
+
+
+def test_td3_config_gates():
+    with pytest.raises(ValueError, match="policy_delay"):
+        DDPGConfig(policy_delay=0)
+    with pytest.raises(ValueError, match="families"):
+        DDPGConfig(twin_critic=True, distributional=True)
+    with pytest.raises(ValueError, match="oracle"):
+        DDPGConfig(twin_critic=True, backend="native")
+    with pytest.raises(ValueError, match="fused_update"):
+        DDPGConfig(twin_critic=True, fused_update=True)
+    # TD3 knobs without twin_critic would silently do nothing.
+    with pytest.raises(ValueError, match="silently"):
+        DDPGConfig(policy_delay=2)
+    with pytest.raises(ValueError, match="silently"):
+        DDPGConfig(target_noise=0.2)
+    from distributed_ddpg_tpu.ops import fused_chunk
+
+    assert not fused_chunk.supported(_cfg())
+
+
+def test_td3_sharded_learner_on_mesh():
+    """The twin ensemble (rank-3 leaves) must flow through the mesh pspec
+    trees, the device-replay sample chunk, and donation on the 8-device
+    CPU mesh."""
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    cfg = _cfg(policy_delay=2, target_noise=0.2, batch_size=8)
+    mesh = mesh_lib.make_mesh(data_axis=4, model_axis=2, devices=jax.devices())
+    lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, mesh=mesh, chunk_size=4)
+    assert not lrn.fused_chunk_active  # TD3 -> scan path
+    rng = np.random.default_rng(3)
+    n = 256
+    dr = DeviceReplay(1024, OBS, ACT, mesh=lrn.mesh, block_size=128)
+    dr.add_packed(
+        pack_batch_np(
+            {
+                "obs": rng.standard_normal((n, OBS)).astype(np.float32),
+                "action": rng.uniform(-1, 1, (n, ACT)).astype(np.float32),
+                "reward": rng.standard_normal(n).astype(np.float32),
+                "discount": np.full(n, 0.99, np.float32),
+                "next_obs": rng.standard_normal((n, OBS)).astype(np.float32),
+            }
+        )
+    )
+    out = lrn.run_sample_chunk(dr)
+    assert np.isfinite(float(out.metrics["critic_loss"]))
+    out2 = lrn.run_sample_chunk(dr)
+    assert np.isfinite(float(out2.metrics["critic_loss"]))
+    # 2 chunks x 4 steps, delay 2 -> 4 actor updates.
+    assert int(jax.device_get(lrn.state.actor_opt.count)) == 4
+    assert int(jax.device_get(lrn.state.critic_opt.count)) == 8
+
+
+@pytest.mark.slow
+def test_td3_train_jax_end_to_end(tmp_path):
+    from distributed_ddpg_tpu.train import train_jax
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), num_actors=2,
+        twin_critic=True, policy_delay=2, target_noise=0.2,
+        total_env_steps=4_000, replay_min_size=500, replay_capacity=20_000,
+        eval_every=0, max_ingest_ratio=50.0,
+        log_path=str(tmp_path / "m.jsonl"),
+    )
+    out = train_jax(cfg)
+    assert out["learner_steps"] >= 40
+    assert np.isfinite(out["final_return"])
