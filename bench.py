@@ -367,6 +367,12 @@ def phase_study() -> dict:
             ("bf16", dict(compute_dtype="bfloat16")),
         )
         for m in ("auto", "off")
+    ] + [
+        # TD3 runs scan-only (the kernel declines twin configs) — one point
+        # records the family's rate.
+        ("td3_scan",
+         base.replace(fused_chunk="off", twin_critic=True,
+                      policy_delay=2, target_noise=0.2)),
     ]
     points = {}
     for key, config in grid:
