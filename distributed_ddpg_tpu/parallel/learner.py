@@ -266,11 +266,13 @@ class ShardedLearner:
                 "small enough for VMEM (ops/fused_chunk.fits_vmem)"
             )
         scan_sample_chunk_fn = sample_chunk_fn
+        fused_run = None  # set on the single-device kernel path; PER reuses it
         if self.fused_chunk_active and not self.fused_mesh_active:
             run_fused = fused_chunk_lib.make_fused_chunk_fn(
                 config, obs_dim, act_dim, action_scale, action_offset,
                 chunk_size=self.chunk_size,
             )
+            fused_run = run_fused
 
             def fused_sample_chunk_fn(s: TrainState, key, storage, size):
                 key, packed = draw_chunk(key, storage, size)
@@ -314,24 +316,63 @@ class ShardedLearner:
 
         storage_sharding = NamedSharding(self.mesh, P(None, None))
         prio_sharding = NamedSharding(self.mesh, P(None))
-        self._per_sample_chunk_step = jax.jit(
-            per_sample_chunk_fn,
-            in_shardings=(
-                self._state_sharding, replicated, storage_sharding, replicated,
-                prio_sharding, replicated, replicated, replicated, replicated,
-            ),
-            out_shardings=(
-                StepOutput(
-                    state=self._state_sharding,
-                    td_errors=NamedSharding(self.mesh, P(None, "data")),
-                    metrics={k: replicated for k in METRIC_KEYS},
+
+        def _jit_per_chunk(fn):
+            return jax.jit(
+                fn,
+                in_shardings=(
+                    self._state_sharding, replicated, storage_sharding,
+                    replicated, prio_sharding, replicated, replicated,
+                    replicated, replicated,
                 ),
-                replicated,
-                prio_sharding,
-                replicated,
-            ),
-            donate_argnums=(0, 1, 4),
-        )
+                out_shardings=(
+                    StepOutput(
+                        state=self._state_sharding,
+                        td_errors=NamedSharding(self.mesh, P(None, "data")),
+                        metrics={k: replicated for k in METRIC_KEYS},
+                    ),
+                    replicated,
+                    prio_sharding,
+                    replicated,
+                ),
+                donate_argnums=(0, 1, 4),
+            )
+
+        self._scan_per_sample_chunk_step = _jit_per_chunk(per_sample_chunk_fn)
+        self.fused_per_active = fused_run is not None
+        if self.fused_per_active:
+            # PER x megakernel: the stratified proportional draw and the
+            # priority scatter live OUTSIDE the kernel (they're cheap,
+            # bandwidth-bound ops XLA handles fine); only the K learner
+            # steps run in the single pallas launch. The IS weights ride in
+            # through the packed wire row's trailing weight column — the
+            # kernel already reads per-row weights from there, so the
+            # kernel needs no PER-specific change. Draw order matches the
+            # scan path exactly (split -> draw_per_indices with identical
+            # shapes), so the two paths are bit-comparable and the fused
+            # path inherits the same priority semantics.
+            def fused_per_sample_chunk_fn(s, key, storage, size, priorities,
+                                          maxp, beta, alpha, eps):
+                key, sub = jax.random.split(key)
+                idx, weights = draw_per_indices(
+                    sub, priorities, size, (self.chunk_size, batch_size), beta
+                )
+                packed = storage[idx].at[..., -1].set(weights)
+                new_s, tds, ms = fused_run(s, packed)
+                out = StepOutput(state=new_s, td_errors=tds, metrics=ms)
+                new_p = (jnp.abs(tds) + eps) ** alpha
+                priorities = priorities.at[idx.reshape(-1)].set(
+                    new_p.reshape(-1)
+                )
+                maxp = jnp.maximum(maxp, new_p.max())
+                return out, key, priorities, maxp
+
+            self._per_sample_chunk_step = _jit_per_chunk(
+                fused_per_sample_chunk_fn
+            )
+        else:
+            self._per_sample_chunk_step = self._scan_per_sample_chunk_step
+        self._per_chunk_compiled = False
         def _jit_sample_chunk(fn):
             return jax.jit(
                 fn,
@@ -506,6 +547,9 @@ class ShardedLearner:
             self.fused_chunk_error = repr(e)[:800]
             self.fused_chunk_active = False
             self.fused_mesh_active = False  # scan = per-step psum semantics
+            # Same kernel program backs the PER variant — don't re-fail there.
+            self.fused_per_active = False
+            self._per_sample_chunk_step = self._scan_per_sample_chunk_step
             self._sample_chunk_step = self._scan_sample_chunk_step
             out, self._key = self._sample_chunk_step(
                 self.state, self._key, storage, size
@@ -518,13 +562,49 @@ class ShardedLearner:
         """K learner steps with proportional PER sampling + priority update
         fused on device (DevicePrioritizedReplay) — the same zero-h2d
         steady state as the uniform path; beta anneals host-side and rides
-        in as a scalar argument."""
+        in as a scalar argument. With the megakernel active the K steps
+        run in one pallas launch (draw + priority scatter stay XLA ops);
+        a kernel COMPILE failure on the first dispatch degrades to the
+        scan path exactly like run_sample_chunk."""
         storage, size, priorities, maxp = device_replay.per_state()
-        out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
-            self.state, self._key, storage, size, priorities, maxp,
+        args = (
             np.float32(beta), np.float32(device_replay.alpha),
             np.float32(device_replay.eps),
         )
+        try:
+            out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
+                self.state, self._key, storage, size, priorities, maxp, *args
+            )
+        except Exception as e:
+            retryable = (
+                self.fused_per_active
+                and self.config.fused_chunk == "auto"
+                and not self._per_chunk_compiled
+                and not any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree.leaves(
+                        (self.state, self._key, priorities)
+                    )
+                )
+            )
+            if not retryable:
+                raise
+            import warnings
+
+            warnings.warn(
+                "fused_chunk='auto': PER megakernel failed on this backend; "
+                f"falling back to the XLA scan path: {e!r}"
+            )
+            self.fused_chunk_error = repr(e)[:800]
+            self.fused_per_active = False
+            # Same kernel program backs the uniform variant — don't re-fail.
+            self.fused_chunk_active = False
+            self._sample_chunk_step = self._scan_sample_chunk_step
+            self._per_sample_chunk_step = self._scan_per_sample_chunk_step
+            out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
+                self.state, self._key, storage, size, priorities, maxp, *args
+            )
+        self._per_chunk_compiled = True
         self.state = out.state
         device_replay.set_per_state(new_p, new_maxp)
         return out

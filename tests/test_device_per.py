@@ -125,3 +125,57 @@ def test_device_per_checkpoint_roundtrip(tmp_path):
         np.asarray(jax.device_get(fresh.storage))[:96],
         np.asarray(jax.device_get(rep.storage))[:96],
     )
+
+
+def test_fused_per_matches_scan_per():
+    """PER x megakernel (round 4): with fused_chunk='on' the PER chunk runs
+    the kernel (draw + priority scatter stay XLA ops, IS weights ride the
+    packed weight column); same key stream -> identical draws -> the end
+    state, TD errors, metrics, AND the updated priority vector must match
+    the scan path at interpret-oracle tolerances. Covers DDPG and D4PG."""
+    for extra in (
+        {},
+        dict(distributional=True, num_atoms=21, v_min=-5.0, v_max=5.0),
+    ):
+        results = {}
+        for mode in ("on", "off"):
+            cfg = DDPGConfig(
+                actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=16,
+                prioritized=True, fused_chunk=mode, seed=7, **extra,
+            )
+            mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+            lrn = ShardedLearner(
+                cfg, 4, 2, action_scale=1.0, mesh=mesh, chunk_size=4
+            )
+            assert lrn.fused_per_active == (mode == "on")
+            rep = DevicePrioritizedReplay(
+                512, 4, 2, mesh=mesh, block_size=64,
+                alpha=cfg.per_alpha, eps=cfg.per_eps,
+            )
+            rep.add_packed(_packed_rows(256, rep.width))
+            out = lrn.run_sample_chunk_per(rep, beta=0.5)
+            assert lrn.fused_chunk_error is None
+            results[mode] = (
+                jax.device_get(lrn.state),
+                np.asarray(out.td_errors),
+                {k: float(v) for k, v in jax.device_get(out.metrics).items()},
+                np.asarray(jax.device_get(rep.priorities)),
+            )
+        s_on, td_on, m_on, p_on = results["on"]
+        s_off, td_off, m_off, p_off = results["off"]
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+            ),
+            s_on.critic_params, s_off.critic_params,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+            ),
+            s_on.actor_opt.mu, s_off.actor_opt.mu,
+        )
+        np.testing.assert_allclose(td_on, td_off, rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(p_on, p_off, rtol=2e-4, atol=1e-6)
+        for k in m_on:
+            np.testing.assert_allclose(m_on[k], m_off[k], rtol=5e-4, atol=1e-6)
