@@ -252,6 +252,12 @@ class ShardedLearner:
             and self.mesh.size > 1
             and self.mesh.shape["model"] == 1
             and config.fused_mesh != "off"
+            # TD3's smoothing-noise stream derives from the replicated
+            # state.step, so per-device kernel chunks would smooth with
+            # IDENTICAL eps on every replica (the iid-noise concern from
+            # the shard_map review); twin configs keep the scan path on
+            # multi-device meshes until the stream is axis-folded.
+            and not config.twin_critic
         )
         self.fused_chunk_active = envelope_ok and (
             self.mesh.size == 1 or self.fused_mesh_active

@@ -80,7 +80,10 @@ def assert_fused_matches_scan(
     close(new_state.target_critic_params, ref.target_critic_params)
     close(new_state.actor_opt.mu, ref.actor_opt.mu)
     close(new_state.critic_opt.nu, ref.critic_opt.nu)
-    assert int(new_state.actor_opt.count) == k
+    # The reference scan IS the count oracle: TD3's delayed actor updates
+    # advance actor_opt.count less often than the critic's.
+    assert int(new_state.actor_opt.count) == int(ref.actor_opt.count)
+    assert int(new_state.critic_opt.count) == int(ref.critic_opt.count) == k
     assert int(new_state.step) == k
     np.testing.assert_allclose(
         np.asarray(td), np.stack(ref_tds), rtol=rtol, atol=atol

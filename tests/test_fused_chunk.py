@@ -96,6 +96,65 @@ def test_fused_chunk_bf16_matches_scan(distributional):
     )
 
 
+@pytest.mark.parametrize("delay,noise", [(1, 0.0), (2, 0.2)])
+def test_fused_chunk_td3_matches_scan(delay, noise):
+    """TD3 in the kernel: twin members as separate rank-2 ref groups,
+    min-over-ensemble targets, smoothing noise STREAMED from the scan
+    path's exact fold_in(seed, step) draw (bit-comparable), and delayed
+    actor/target updates under pl.when with closed-form actor-count
+    bookkeeping. The reference scan is also the Adam-count oracle."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 24, 16), batch_size=B,
+        twin_critic=True, policy_delay=delay, target_noise=noise, seed=3,
+    )
+    assert fused_chunk.supported(cfg)
+    assert_fused_matches_scan(
+        cfg, OBS, ACT, 5, 1.5, 0.25,
+        interpret=True, rtol=2e-4, atol=1e-5, metric_rtol=5e-4,
+    )
+
+
+def test_fused_chunk_td3_step_offset_continuity():
+    """The delayed-update schedule and the noise stream key off the GLOBAL
+    step, so a chunk starting at an arbitrary step0 must keep matching the
+    scan path — two consecutive fused chunks vs two scan chunks through
+    the public run_sample_chunk API (same draw stream)."""
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.parallel.mesh import make_mesh
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B,
+        twin_critic=True, policy_delay=2, target_noise=0.2, seed=5,
+    )
+    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+    rows = _batches(np.random.default_rng(11), 16).reshape(-1, 2 * OBS + ACT + 3)
+    results = {}
+    for mode in ("on", "off"):
+        lrn = ShardedLearner(
+            cfg.replace(fused_chunk=mode), OBS, ACT,
+            action_scale=1.0, mesh=mesh, chunk_size=3,  # odd K: step0 drifts
+        )
+        assert lrn.fused_chunk_active == (mode == "on")
+        rep = DeviceReplay(
+            capacity=256, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=256
+        )
+        rep.add_packed(rows)
+        for _ in range(3):  # chunk boundaries at steps 3, 6 (odd offsets)
+            out = lrn.run_sample_chunk(rep)
+        results[mode] = (jax.device_get(lrn.state), np.asarray(out.td_errors))
+    s_on, td_on = results["on"]
+    s_off, td_off = results["off"]
+    _assert_tree_close(s_on.critic_params, s_off.critic_params, rtol=5e-4, atol=1e-5)
+    _assert_tree_close(s_on.actor_params, s_off.actor_params, rtol=5e-4, atol=1e-5)
+    _assert_tree_close(s_on.target_critic_params, s_off.target_critic_params, rtol=5e-4, atol=1e-5)
+    np.testing.assert_allclose(td_on, td_off, rtol=5e-4, atol=1e-4)
+    assert int(s_on.actor_opt.count) == int(s_off.actor_opt.count)
+    assert int(s_on.critic_opt.count) == 9
+
+
 def test_sharded_learner_fused_path_matches_scan_path():
     """On a 1-device mesh, fused_chunk='on' must reproduce fused_chunk='off'
     through the public run_sample_chunk API: both draw the same (K, B) index

@@ -142,7 +142,10 @@ def test_td3_config_gates():
         DDPGConfig(target_noise=0.2)
     from distributed_ddpg_tpu.ops import fused_chunk
 
-    assert not fused_chunk.supported(_cfg())
+    # TD3 is INSIDE the kernel envelope (round 4, second pass): twin
+    # members flatten to rank-2 refs, noise streams in, updates delay
+    # under pl.when. Parity: test_fused_chunk.py::test_fused_chunk_td3_*.
+    assert fused_chunk.supported(_cfg())
 
 
 def test_td3_sharded_learner_on_mesh():

@@ -85,6 +85,14 @@ def test_fused_kernel_native_parity_bf16(tpu):
     assert out["ok"]
 
 
+def test_fused_kernel_native_parity_td3(tpu):
+    """The TD3 kernel branch — twin member groups, streamed smoothing
+    noise, pl.when-delayed updates — must compile under real Mosaic and
+    match the scan path."""
+    out = _run_child("fused_parity_td3")
+    assert out["ok"]
+
+
 def test_device_replay_ingest_and_sample_chunk(tpu):
     """Real h2d DeviceReplay ingest + the production run_sample_chunk
     dispatch; fused_chunk='auto' must actually activate on real TPU (if it
