@@ -368,11 +368,10 @@ def phase_study() -> dict:
         )
         for m in ("auto", "off")
     ] + [
-        # TD3 runs scan-only (the kernel declines twin configs) — one point
-        # records the family's rate.
-        ("td3_scan",
-         base.replace(fused_chunk="off", twin_critic=True,
-                      policy_delay=2, target_noise=0.2)),
+        (f"td3_{'fused' if m == 'auto' else 'scan'}",
+         base.replace(fused_chunk=m, twin_critic=True,
+                      policy_delay=2, target_noise=0.2))
+        for m in ("auto", "off")
     ]
     points = {}
     for key, config in grid:
