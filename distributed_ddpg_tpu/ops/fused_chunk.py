@@ -564,6 +564,36 @@ def runs_native() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+def td3_noise_base_key(config: DDPGConfig):
+    """The TD3 smoothing-noise base key. MUST stay identical to
+    learner.make_learner_step's td3_base_key — the kernel wrapper and the
+    fused-mesh path pre-draw from this stream to stay bit-comparable with
+    the scan path."""
+    return jax.random.PRNGKey(config.seed ^ 0x7D3AF)
+
+
+def td3_noise_eps(config: DDPGConfig, step0, chunk: int, batch: int,
+                  act_dim: int, device_fold=None):
+    """Pre-draw a chunk's target-smoothing noise [K, B, act], scaled and
+    clipped, from fold_in(base, global_step) — the scan path's exact
+    stream. `device_fold` (e.g. lax.axis_index under shard_map) folds a
+    per-device term AFTER the step fold, matching the scan path's
+    axis_name handling so sharded chunks draw iid noise per replica."""
+    base = td3_noise_base_key(config)
+    keys = jax.vmap(lambda s_: jax.random.fold_in(base, s_))(
+        step0 + jnp.arange(chunk)
+    )
+    if device_fold is not None:
+        keys = jax.vmap(lambda kk: jax.random.fold_in(kk, device_fold))(keys)
+    return jax.vmap(
+        lambda kk: jnp.clip(
+            config.target_noise * jax.random.normal(kk, (batch, act_dim)),
+            -config.target_noise_clip,
+            config.target_noise_clip,
+        )
+    )(keys)
+
+
 def make_fused_chunk_fn(
     config: DDPGConfig,
     obs_dim: int,
@@ -609,16 +639,10 @@ def make_fused_chunk_fn(
     )
     twin = bool(config.twin_critic)
     has_noise = twin and config.target_noise > 0.0
-    # Must match learner.make_learner_step's td3_base_key exactly — the
-    # kernel streams the SAME fold_in(seed, step) noise the scan path draws,
-    # which is what makes the two paths bit-comparable under smoothing.
-    td3_base_key = (
-        jax.random.PRNGKey(config.seed ^ 0x7D3AF) if has_noise else None
-    )
 
     from distributed_ddpg_tpu.learner import METRIC_KEYS
 
-    def run(state: TrainState, batches):
+    def run(state: TrainState, batches, eps=None):
         n_actor = len(state.actor_params)
         n_critic = len(state.critic_params)
         na2, nc2 = 2 * n_actor, 2 * n_critic
@@ -642,22 +666,15 @@ def make_fused_chunk_fn(
             + flat_c(state.critic_opt.nu)
         )
 
-        eps = None
-        if has_noise:
+        if has_noise and eps is None:
             # Pre-draw the whole chunk's smoothing noise [K, B, act] from
             # the scan path's exact key stream (fold_in per global step),
             # pre-scaled and pre-clipped; it streams into the kernel like
-            # the minibatches (~KB per step).
-            keys = jax.vmap(
-                lambda s_: jax.random.fold_in(td3_base_key, s_)
-            )(state.step + jnp.arange(K))
-            eps = jax.vmap(
-                lambda kk: jnp.clip(
-                    config.target_noise * jax.random.normal(kk, (B, a)),
-                    -config.target_noise_clip,
-                    config.target_noise_clip,
-                )
-            )(keys)
+            # the minibatches (~KB per step). Callers with a device axis
+            # (fused-mesh) pass their own axis-folded eps instead.
+            eps = td3_noise_eps(config, state.step, K, B, a)
+        elif not has_noise:
+            eps = None
 
         def stream_spec(d):
             return pl.BlockSpec(

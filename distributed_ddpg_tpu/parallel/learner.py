@@ -252,12 +252,6 @@ class ShardedLearner:
             and self.mesh.size > 1
             and self.mesh.shape["model"] == 1
             and config.fused_mesh != "off"
-            # TD3's smoothing-noise stream derives from the replicated
-            # state.step, so per-device kernel chunks would smooth with
-            # IDENTICAL eps on every replica (the iid-noise concern from
-            # the shard_map review); twin configs keep the scan path on
-            # multi-device meshes until the stream is axis-folded.
-            and not config.twin_critic
         )
         self.fused_chunk_active = envelope_ok and (
             self.mesh.size == 1 or self.fused_mesh_active
@@ -440,12 +434,24 @@ class ShardedLearner:
         mesh = self.mesh
         state_spec = mesh_lib.state_pspec(self.state, mesh)
 
+        twin_noise = self.config.twin_critic and self.config.target_noise > 0
+
         def local_chunk(s, sub, storage, size):
-            dkey = jax.random.fold_in(sub, jax.lax.axis_index("data"))
+            axis_idx = jax.lax.axis_index("data")
+            dkey = jax.random.fold_in(sub, axis_idx)
             idx = jax.random.randint(
                 dkey, (K, b_local), 0, jnp.maximum(size, 1)
             )
-            new_s, tds, ms = run_fused(s, storage[idx])
+            eps = None
+            if twin_noise:
+                # Per-device iid smoothing noise: the scan path's
+                # fold_in(seed, step) stream with the device index folded
+                # on top (mirrors make_learner_step's axis_name handling).
+                eps = fused_chunk_lib.td3_noise_eps(
+                    self.config, s.step, K, b_local, self.act_dim,
+                    device_fold=axis_idx,
+                )
+            new_s, tds, ms = run_fused(s, storage[idx], eps=eps)
             avg = lambda x: jax.lax.pmean(x, "data")
             favg = lambda tree: jax.tree.map(avg, tree)
             new_s = TrainState(

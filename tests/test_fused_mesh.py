@@ -204,6 +204,36 @@ def test_fused_mesh_bounded_divergence_vs_scan_path():
     assert cross_gap < moved, (cross_gap, moved)
 
 
+@pytest.mark.parametrize(
+    "extra",
+    [
+        dict(distributional=True, num_atoms=21, v_min=-5.0, v_max=5.0),
+        dict(twin_critic=True, policy_delay=2, target_noise=0.2),
+    ],
+    ids=["d4pg", "td3"],
+)
+def test_fused_mesh_runs_all_families(extra):
+    """The mesh composition must cover every kernel-envelope family: D4PG
+    (C51 head in-kernel) and TD3 (twin groups + per-device axis-folded
+    smoothing noise — each replica draws iid eps)."""
+    cfg = _cfg(**extra)
+    mesh = mesh_lib.make_mesh(data_axis=4, devices=jax.devices()[:4])
+    lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, mesh=mesh, chunk_size=3)
+    assert lrn.fused_mesh_active
+    dr = _filled_replay(lrn.mesh)
+    out = lrn.run_sample_chunk(dr)
+    assert lrn.fused_chunk_error is None
+    assert out.td_errors.shape == (3, 8 * 4)
+    for v in out.metrics.values():
+        assert np.isfinite(float(v))
+    out2 = lrn.run_sample_chunk(dr)
+    assert np.isfinite(float(out2.metrics["critic_loss"]))
+    if "twin_critic" in extra:
+        # Delay 2 over 6 critic steps -> 3 actor updates, replicas agree.
+        assert int(jax.device_get(lrn.state.actor_opt.count)) == 3
+        assert int(jax.device_get(lrn.state.critic_opt.count)) == 6
+
+
 def test_fused_mesh_respects_off_and_model_parallel():
     mesh = mesh_lib.make_mesh(data_axis=4, model_axis=2, devices=jax.devices())
     lrn = ShardedLearner(
