@@ -209,3 +209,22 @@ def test_ondevice_rejects_per_and_nstep():
         OnDeviceDDPG(_tiny_config(prioritized=True))
     with pytest.raises(ValueError, match="1-step"):
         OnDeviceDDPG(_tiny_config(n_step=3))
+
+
+def test_ondevice_runs_all_families():
+    """The fully-fused backend (env + replay + learner in one XLA program)
+    must compose with every algorithm family: the TD3 lax.cond-delayed
+    updates and fold_in noise, and the D4PG categorical head, both trace
+    cleanly inside the ondevice scan."""
+    from distributed_ddpg_tpu.ondevice import OnDeviceDDPG
+
+    for extra in (
+        dict(twin_critic=True, policy_delay=2, target_noise=0.2),
+        dict(distributional=True, num_atoms=21, v_min=-200.0, v_max=200.0),
+    ):
+        trainer = OnDeviceDDPG(_tiny_config(**extra), chunk_size=4)
+        for _ in range(4):
+            stats = trainer.run_chunk()
+        host = trainer.finalize_stats(stats)
+        assert np.isfinite(host["critic_loss"])
+        assert trainer.learn_steps > 0
