@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from distributed_ddpg_tpu.actors.policy import (
+    actor_head_dim,
     decode_version,
     flatten_params,
     layout_size,
@@ -50,7 +51,11 @@ class ActorPool:
         self.num_actors = num_actors or config.num_actors
         self.heartbeat_timeout = heartbeat_timeout
         self._ctx = mp.get_context("spawn")
-        self.layout = param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden))
+        self.layout = param_layout(
+            spec.obs_dim,
+            actor_head_dim(spec.act_dim, config.sac),
+            tuple(config.actor_hidden),
+        )
         self._shared = self._ctx.Array("f", layout_size(self.layout), lock=False)
         self._version = self._ctx.Value("l", 0)
         self._queue = self._ctx.Queue(maxsize=4 * self.num_actors)
@@ -90,6 +95,10 @@ class ActorPool:
         self._procs: List[Optional[mp.Process]] = [None] * self.num_actors
         self._respawns = 0
         self._steps_received = 0
+        # Env-step progress restored from a checkpoint (set by the driver
+        # BEFORE start()): counts against the uniform-warmup budget so a
+        # resumed run doesn't re-inject warmup_uniform random actions.
+        self.env_steps_offset = 0
         # Param-staleness tracking (SURVEY.md §5 'params-staleness per
         # actor'): even version -> learner step at broadcast, pruned to the
         # most recent entries; per-worker staleness updated on drain.
@@ -98,6 +107,20 @@ class ActorPool:
         self._staleness = np.zeros(self.num_actors, np.int64)
 
     # --- lifecycle ---
+
+    def warmup_budget_per_worker(self) -> int:
+        """REMAINING per-worker uniform-warmup budget at spawn time: the
+        global budget (config.resolved_warmup_uniform) net of checkpoint-
+        resume progress and steps already drained — a respawned or resumed
+        worker must not re-inject random actions into a trained run's
+        replay — split evenly (ceil) across the pool."""
+        remaining = max(
+            0,
+            self.config.resolved_warmup_uniform()
+            - self.env_steps_offset
+            - self._steps_received,
+        )
+        return (remaining + self.num_actors - 1) // self.num_actors
 
     def _spawn(self, worker_id: int) -> None:
         fault_step = 0
@@ -132,6 +155,11 @@ class ActorPool:
                 n_step=self.config.n_step,
                 gamma=self.config.gamma,
                 fault_step=fault_step,
+                throttle_s=self.config.actor_throttle_s,
+                gaussian_policy=self.config.sac,
+                log_std_min=self.config.sac_log_std_min,
+                log_std_max=self.config.sac_log_std_max,
+                warmup_uniform=self.warmup_budget_per_worker(),
                 episode_queue=self._episodes,
                 # Orphan guard (worker.py): the worker compares getppid()
                 # against the pool process's REAL pid, captured here at

@@ -43,6 +43,11 @@ def run_worker(
     gamma: float,
     send_every: int = 32,
     fault_step: int = 0,
+    throttle_s: float = 0.0,
+    gaussian_policy: bool = False,  # SAC: sample the policy, no OU noise
+    log_std_min: float = -5.0,
+    log_std_max: float = 2.0,
+    warmup_uniform: int = 0,  # uniform-random actions for the first N steps
     episode_queue=None,     # optional mp.Queue for (worker_id, return, length)
     parent_pid: int = 0,    # pool process pid, captured at spawn time
 ) -> None:
@@ -62,9 +67,27 @@ def run_worker(
 
     env = make(env_id, seed=seed)
     act_dim = len(np.atleast_1d(action_low))
-    policy = NumpyPolicy(layout, action_scale, action_offset)
-    noise = OUNoise((act_dim,), theta=ou_theta, sigma=ou_sigma, dt=ou_dt, seed=seed)
+    policy = NumpyPolicy(
+        layout,
+        action_scale,
+        action_offset,
+        gaussian=gaussian_policy,
+        stochastic=gaussian_policy,
+        seed=seed,
+        log_std_min=log_std_min,
+        log_std_max=log_std_max,
+    )
+    # SAC explores by sampling its own tanh-Gaussian; the OU process is
+    # zeroed (sigma=0 keeps the loop shape identical at no cost).
+    noise = OUNoise(
+        (act_dim,),
+        theta=ou_theta,
+        sigma=0.0 if gaussian_policy else ou_sigma,
+        dt=ou_dt,
+        seed=seed,
+    )
     nstep = NStepAccumulator(n_step, gamma)
+    warmup_rng = np.random.default_rng(seed + 7919)  # uniform-warmup draws
     flat_view = np.frombuffer(shared_params, dtype=np.float32)
     flat_scratch = np.empty_like(flat_view)
     seen_version = -1
@@ -185,7 +208,22 @@ def run_worker(
             return
         heartbeat[worker_id] = time.time()
         maybe_refresh()
-        action = policy(obs)[0] + noise() * np.asarray(action_scale, np.float32)
+        if throttle_s > 0.0:
+            # Staleness-sweep experiment knob (config.actor_throttle_s):
+            # slow env production so the learner can saturate the ratio
+            # caps on slow hosts. Sleep sits BEFORE the step so the
+            # heartbeat above keeps the respawn monitor quiet.
+            time.sleep(throttle_s)
+        if total_steps < warmup_uniform:
+            # Uniform-random warmup (config.warmup_uniform_steps — SAC's
+            # start_steps): broad seed data before the policy takes over.
+            action = warmup_rng.uniform(action_low, action_high).astype(
+                np.float32
+            )
+        else:
+            action = policy(obs)[0] + noise() * np.asarray(
+                action_scale, np.float32
+            )
         action = np.clip(action, action_low, action_high).astype(np.float32)
         next_obs, reward, terminated, truncated, _ = env.step(action)
         done = terminated  # truncation bootstraps: discount stays gamma^n

@@ -23,6 +23,7 @@ from distributed_ddpg_tpu.learner import (
     init_train_state,
     jit_learner_step,
     make_act_fn,
+    make_sample_fn,
 )
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import NStepAccumulator, make_replay
@@ -40,6 +41,17 @@ class DDPGAgent:
         self._act_fn = make_act_fn(
             config, spec.action_scale, action_offset=spec.action_offset
         )
+        # SAC explores by sampling its own policy; OU noise stays unused.
+        self._sample_fn = (
+            make_sample_fn(config, spec.action_scale, action_offset=spec.action_offset)
+            if config.sac
+            else None
+        )
+        self._act_key = jax.random.PRNGKey(config.seed + 2) if config.sac else None
+        # Uniform-random warmup (SAC start_steps; config.warmup_uniform_steps).
+        self._warmup_uniform = config.resolved_warmup_uniform()
+        self._warmup_rng = np.random.default_rng(config.seed + 3)
+        self._env_steps = 0
         self.replay = make_replay(config, spec.obs_dim, spec.act_dim)
         self.noise = OUNoise(
             (spec.act_dim,),
@@ -54,6 +66,16 @@ class DDPGAgent:
     # --- acting (SURVEY.md §3.2) ---
 
     def act(self, obs: np.ndarray, explore: bool = True) -> np.ndarray:
+        if explore and self._env_steps < self._warmup_uniform:
+            return self._warmup_rng.uniform(
+                self.spec.action_low, self.spec.action_high
+            ).astype(np.float32)
+        if explore and self.config.sac:
+            self._act_key, k = jax.random.split(self._act_key)
+            action = np.asarray(
+                self._sample_fn(self.state.actor_params, obs[None], k)
+            )[0]
+            return np.clip(action, self.spec.action_low, self.spec.action_high)
         action = np.asarray(self._act_fn(self.state.actor_params, obs[None]))[0]
         if explore:
             action = action + self.noise() * self.spec.action_scale
@@ -66,6 +88,7 @@ class DDPGAgent:
     # --- experience (SURVEY.md §3.2 replay.add) ---
 
     def observe(self, obs, action, reward, done, next_obs) -> None:
+        self._env_steps += 1
         for o, a, r, disc, nobs in self.nstep.push(
             obs[None], action[None], [reward], [done], next_obs[None]
         ):

@@ -39,6 +39,8 @@ COMPAT_FIELDS = (
     "action_insert_layer",
     "distributional",
     "twin_critic",  # rank-3 ensemble critic leaves vs rank-2 plain ones
+    "sac",  # double-width Gaussian head + twin leaves + log_alpha node
+    "sac_autotune",  # alpha_opt presence changes the TrainState tree
     "num_atoms",
     "v_min",
     "v_max",

@@ -55,6 +55,39 @@ class DDPGConfig:
     target_noise: float = 0.0
     target_noise_clip: float = 0.5
 
+    # --- SAC (arXiv 1801.01290/1812.05905; third beyond-parity family) ---
+    # sac: stochastic tanh-Gaussian actor (head outputs [mean | log_std],
+    # reparameterized sampling, tanh log-prob correction), twin critics
+    # stacked on a leading axis exactly like TD3's, and entropy-regularized
+    # Bellman targets min_i Q_i(s',a') - alpha * log pi(a'|s'). Exploration
+    # comes from the policy itself: workers sample (no OU noise), eval acts
+    # on tanh(mean).
+    sac: bool = False
+    # Entropy temperature. With sac_autotune the learner treats log(alpha)
+    # as a learned scalar driving policy entropy toward target_entropy
+    # (nan = auto = -act_dim + sum(log action_scale) — the 1812.05905
+    # -act_dim heuristic expressed in this codebase's env-unit log-probs;
+    # see learner.sac_step. nan, not 0, is the sentinel: an exact-zero
+    # entropy target is inside the knob's valid domain); sac_alpha is then
+    # just the initial value.
+    sac_alpha: float = 0.2
+    sac_autotune: bool = True
+    target_entropy: float = float("nan")
+    # log_std clamp for the Gaussian head (standard SAC stability bounds).
+    sac_log_std_min: float = -5.0
+    sac_log_std_max: float = 2.0
+    # Uniform-random action warmup (SAC's classic `start_steps`): for the
+    # first N env steps actions are drawn uniformly from the action box
+    # instead of the policy. SAC NEEDS this: its exploration is the
+    # policy's own (initially narrow, entropy-bounded) Gaussian, and
+    # without broad seed data swing-up style tasks never see the good
+    # region (measured: Pendulum stuck ~-1100 @25k without, solved -78
+    # with — docs/EVIDENCE.md §3). OU-driven families explore broadly from
+    # step 0, so warmup only applies where configured. -1 = auto
+    # (replay_min_size when sac, else 0); 0 = off. In the actor pool the
+    # budget is split evenly across workers.
+    warmup_uniform_steps: int = -1
+
     # --- replay (SURVEY.md §2 #5/#7) ---
     replay_capacity: int = 1_000_000
     replay_min_size: int = 1_000     # warmup before learning starts
@@ -110,6 +143,15 @@ class DDPGConfig:
     # ratio * env steps. The reference's sync semantics are ratio = 1/
     # train_every; 0 = free-running async (learner as fast as the TPU goes).
     max_learn_ratio: float = 0.0
+    # Experiment knob: per-env-step sleep (seconds) inside each worker.
+    # 0 = off (production). Nonzero slows env production so the LEARNER can
+    # saturate the ratio caps on hosts where it otherwise couldn't — the
+    # staleness sweep (docs/EVIDENCE.md §4) needs learner capability >>
+    # cap x env rate for a cap to bind at all; on the 1-core CPU host the
+    # unthrottled 16-actor config keeps the effective ratio < 1 and every
+    # sweep point would silently measure the same thing. Wall-clock only:
+    # the algorithmic quantity (grad steps per env step) is unchanged.
+    actor_throttle_s: float = 0.0
     param_refresh_every: int = 1     # learner steps between actor param refresh
     # Wall-clock floor between actor param broadcasts in train_jax. A
     # broadcast must sync the in-flight chunk and round-trip params
@@ -170,6 +212,13 @@ class DDPGConfig:
 
     def replace(self, **kwargs) -> "DDPGConfig":
         return dataclasses.replace(self, **kwargs)
+
+    def resolved_warmup_uniform(self) -> int:
+        """Global uniform-warmup env-step budget (see warmup_uniform_steps:
+        -1 = auto = replay_min_size for SAC, 0 otherwise)."""
+        if self.warmup_uniform_steps >= 0:
+            return self.warmup_uniform_steps
+        return self.replay_min_size if self.sac else 0
 
     @classmethod
     def from_flags(cls, argv: Sequence[str]) -> "DDPGConfig":
@@ -242,6 +291,28 @@ class DDPGConfig:
                 "twin_critic (TD3) and distributional (D4PG) are separate "
                 "algorithm families; enable one"
             )
+        if self.sac and (self.twin_critic or self.distributional):
+            raise ValueError(
+                "sac is its own algorithm family (it builds its twin-critic "
+                "ensemble internally); disable twin_critic/distributional"
+            )
+        if self.sac and self.fused_update:
+            raise ValueError(
+                "sac composes with the stock Adam+Polyak tree update (the "
+                "alpha scalar rides the same path), not the fused_update "
+                "kernel"
+            )
+        if self.sac and self.backend not in ("jax_tpu",):
+            raise ValueError(
+                "sac requires backend='jax_tpu': the native numpy learner is "
+                "the plain-DDPG bit-comparability oracle, and the ondevice "
+                "fused program acts deterministically (stochastic on-device "
+                "acting is not wired yet)"
+            )
+        if self.sac_alpha <= 0:
+            raise ValueError("sac_alpha must be > 0 (it is exp(log_alpha))")
+        if self.sac_log_std_min >= self.sac_log_std_max:
+            raise ValueError("sac_log_std_min must be < sac_log_std_max")
         if self.twin_critic and self.fused_update:
             raise ValueError(
                 "twin_critic composes with the stock Adam+Polyak tree update"
@@ -258,6 +329,12 @@ class DDPGConfig:
             raise ValueError("learner_chunk must be >= 0 (0 = auto)")
         if self.max_learn_ratio < 0:
             raise ValueError("max_learn_ratio must be >= 0 (0 = unlimited)")
+        if self.actor_throttle_s < 0:
+            raise ValueError("actor_throttle_s must be >= 0 (0 = off)")
+        if self.warmup_uniform_steps < -1:
+            raise ValueError(
+                "warmup_uniform_steps must be >= -1 (-1 = auto, 0 = off)"
+            )
         if (
             self.max_learn_ratio > 0
             and self.max_ingest_ratio > 0

@@ -66,8 +66,18 @@ def init_train_state(config: DDPGConfig, obs_dim: int, act_dim: int, seed: int) 
     key = jax.random.PRNGKey(seed)
     k_actor, k_critic = jax.random.split(key)
     num_outputs = config.num_atoms if config.distributional else 1
-    actor_params = actor_init(k_actor, obs_dim, act_dim, tuple(config.actor_hidden))
-    if config.twin_critic:
+    # SAC's stochastic head emits [mean | log_std] — double-width output
+    # (actor_head_dim is the single source of the width rule; the actor
+    # pool sizes its shared-memory layout with the same helper).
+    from distributed_ddpg_tpu.actors.policy import actor_head_dim
+
+    actor_params = actor_init(
+        k_actor,
+        obs_dim,
+        actor_head_dim(act_dim, config.sac),
+        tuple(config.actor_hidden),
+    )
+    if config.twin_critic or config.sac:
         # TD3 ensemble: two independently-initialized critics stacked on a
         # leading axis — the TrainState SHAPE is unchanged (same tree, each
         # critic leaf just gains a [2, ...] dim), so checkpointing, Adam,
@@ -109,6 +119,22 @@ def init_train_state(config: DDPGConfig, obs_dim: int, act_dim: int, seed: int) 
             count=jnp.zeros((), jnp.int32),
         ),
         step=jnp.zeros((), jnp.int32),
+        # SAC entropy temperature: learned log(alpha) scalar + its own Adam
+        # state (None = empty pytree nodes for every other family).
+        log_alpha=(
+            jnp.asarray(jnp.log(config.sac_alpha), jnp.float32)
+            if config.sac
+            else None
+        ),
+        alpha_opt=(
+            OptState(
+                mu=jnp.zeros((), jnp.float32),
+                nu=jnp.zeros((), jnp.float32),
+                count=jnp.zeros((), jnp.int32),
+            )
+            if (config.sac and config.sac_autotune)
+            else None
+        ),
     )
 
 
@@ -142,6 +168,139 @@ def make_learner_step(
         if config.twin_critic
         else None
     )
+    # SAC sampling noise: same fold_in(base, step) discipline as TD3 —
+    # deterministic, replayable, replica-identical (then axis-folded per
+    # shard so a global batch gets globally-unique draws).
+    sac_base_key = (
+        jax.random.PRNGKey(config.seed ^ 0x5AC0) if config.sac else None
+    )
+
+    def sac_step(state: TrainState, batch: Batch) -> StepOutput:
+        """SAC: entropy-regularized twin-critic TD + reparameterized actor
+        + (optionally) the learned temperature. Kept as its own body — the
+        actor loss carries an aux (mean log-prob -> alpha update) that the
+        shared branch structure below has no slot for."""
+        key = jax.random.fold_in(sac_base_key, state.step)
+        if axis_name is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        k_next, k_cur = jax.random.split(key)
+        alpha = jnp.exp(state.log_alpha)
+
+        def critic_loss_fn(cp):
+            return losses.sac_critic_loss(
+                cp, state.actor_params, state.target_critic_params, batch,
+                scale, k_next, alpha,
+                config.sac_log_std_min, config.sac_log_std_max,
+                ail, config.critic_l2, offset, mm,
+            )
+
+        (closs, td), cgrads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
+            state.critic_params
+        )
+        cgrads = _maybe_psum_mean(cgrads, axis_name)
+
+        # Actor gradient against the pre-update critic (file convention).
+        def actor_loss_fn(ap):
+            return losses.sac_actor_loss(
+                ap, state.critic_params, batch, scale, k_cur, alpha,
+                config.sac_log_std_min, config.sac_log_std_max,
+                ail, offset, mm,
+            )
+
+        (aloss, mean_lp), agrads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(state.actor_params)
+        agrads = _maybe_psum_mean(agrads, axis_name)
+        # Global mean log-prob so every shard's alpha update sees the same
+        # scalar (replicas must not fork on log_alpha).
+        mean_lp = _maybe_psum_mean(mean_lp, axis_name)
+
+        new_critic, critic_opt = adam_update(
+            state.critic_params, cgrads, state.critic_opt, config.critic_lr
+        )
+        new_actor, actor_opt = adam_update(
+            state.actor_params, agrads, state.actor_opt, config.actor_lr
+        )
+        new_target_critic = polyak_update(
+            new_critic, state.target_critic_params, config.tau
+        )
+        # SAC's math has no target actor; the slot still trails the actor
+        # via the same polyak so the TrainState invariants (targets trail
+        # params) and checkpoint shape stay uniform across families.
+        new_target_actor = polyak_update(
+            new_actor, state.target_actor_params, config.tau
+        )
+
+        if config.sac_autotune:
+            # J(log_alpha) = -log_alpha * (E[log pi] + target_H);
+            # d/dlog_alpha = -(E[log pi] + target_H), exact — no autodiff
+            # needed for a scalar with a linear objective. target_entropy
+            # nan = auto: the 1812.05905 heuristic -act_dim is stated for
+            # UNIT-box log-probs; ours live in env units (sac_sample folds
+            # -log(scale) per dim), so the equivalent target shifts by
+            # +sum(log scale) — without the shift, any env with scale > 1
+            # gets a LOWER-entropy target than standard SAC and alpha
+            # collapses (measured on Pendulum, scale 2: alpha -> 0.017 and
+            # stuck; shifted target matches standard behavior). act_dim is
+            # static under jit from the batch's action shape.
+            import math
+
+            if not math.isnan(config.target_entropy):
+                tgt_h = config.target_entropy
+            else:
+                import numpy as np
+
+                a_dim = batch.action.shape[-1]
+                # Plain numpy on the closure's host-side action_scale: the
+                # target is a trace-time Python constant (jnp here would
+                # yield a tracer under jit).
+                tgt_h = -float(a_dim) + float(
+                    np.sum(
+                        np.log(
+                            np.broadcast_to(
+                                np.asarray(action_scale, np.float64), (a_dim,)
+                            )
+                        )
+                    )
+                )
+            alpha_grad = -(jax.lax.stop_gradient(mean_lp) + tgt_h)
+            new_log_alpha, alpha_opt = adam_update(
+                state.log_alpha, alpha_grad, state.alpha_opt, config.critic_lr
+            )
+        else:
+            new_log_alpha, alpha_opt = state.log_alpha, state.alpha_opt
+
+        # mean_q recovered exactly: aloss = E[alpha*lp - minQ]
+        # => E[minQ] = alpha * mean_lp - aloss.
+        metrics = dict(
+            zip(
+                METRIC_KEYS,
+                (
+                    closs,
+                    aloss,
+                    alpha * mean_lp - aloss,
+                    jnp.mean(jnp.abs(td)),
+                    optree_norm(cgrads),
+                    optree_norm(agrads),
+                ),
+            )
+        )
+        metrics = _maybe_psum_mean(metrics, axis_name)
+        new_state = TrainState(
+            actor_params=new_actor,
+            critic_params=new_critic,
+            target_actor_params=new_target_actor,
+            target_critic_params=new_target_critic,
+            actor_opt=actor_opt,
+            critic_opt=critic_opt,
+            step=state.step + 1,
+            log_alpha=new_log_alpha,
+            alpha_opt=alpha_opt,
+        )
+        return StepOutput(state=new_state, td_errors=td, metrics=metrics)
+
+    if config.sac:
+        return sac_step
 
     def step(state: TrainState, batch: Batch) -> StepOutput:
         # --- critic update ---
@@ -346,14 +505,45 @@ def jit_learner_step(config: DDPGConfig, action_scale, donate: bool = True, acti
 
 
 def make_act_fn(config: DDPGConfig, action_scale, action_offset=0.0):
-    """Jitted deterministic policy for evaluation/acting on device."""
-    from distributed_ddpg_tpu.models.mlp import actor_apply
+    """Jitted deterministic policy for evaluation/acting on device.
+    SAC evaluates on the distribution mode: tanh(mean) onto the box."""
+    from distributed_ddpg_tpu.models.mlp import actor_apply, actor_gaussian_apply
 
     scale = jnp.asarray(action_scale, jnp.float32)
     offset = jnp.asarray(action_offset, jnp.float32)
+
+    if config.sac:
+
+        @jax.jit
+        def act(actor_params, obs):
+            mean, _ = actor_gaussian_apply(
+                actor_params, obs, config.sac_log_std_min, config.sac_log_std_max
+            )
+            return jnp.tanh(mean) * scale + offset
+
+        return act
 
     @jax.jit
     def act(actor_params, obs):
         return actor_apply(actor_params, obs, scale, offset)
 
     return act
+
+
+def make_sample_fn(config: DDPGConfig, action_scale, action_offset=0.0):
+    """Jitted stochastic SAC policy (exploration): a ~ pi(.|s)."""
+    from distributed_ddpg_tpu.models.mlp import actor_gaussian_apply
+    from distributed_ddpg_tpu.ops import losses as losses_lib
+
+    scale = jnp.asarray(action_scale, jnp.float32)
+    offset = jnp.asarray(action_offset, jnp.float32)
+
+    @jax.jit
+    def sample(actor_params, obs, key):
+        mean, log_std = actor_gaussian_apply(
+            actor_params, obs, config.sac_log_std_min, config.sac_log_std_max
+        )
+        action, _ = losses_lib.sac_sample(mean, log_std, key, scale, offset)
+        return action
+
+    return sample

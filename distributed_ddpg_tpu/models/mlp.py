@@ -86,6 +86,27 @@ def actor_apply(params: Params, obs, action_scale, action_offset=0.0, mm_dtype=N
     return jnp.tanh(x) * action_scale + action_offset
 
 
+def actor_gaussian_apply(
+    params: Params, obs, log_std_min: float, log_std_max: float, mm_dtype=None
+):
+    """SAC stochastic head: the final layer outputs [mean | log_std]
+    (2*act_dim wide — build params with actor_init(act_dim=2*act_dim)).
+    Returns RAW (mean, log_std); sampling + tanh squash + the log-prob
+    correction live in ops/losses.py so this stays a pure network apply.
+    log_std is soft-clamped onto [min, max] with a tanh map — a hard clip
+    would zero its gradient exactly where autotuned-alpha training tends
+    to push it."""
+    x = obs
+    for layer in params[:-1]:
+        x = jax.nn.relu(_dense(x, layer, mm_dtype))
+    x = _dense(x, params[-1], mm_dtype)
+    mean, log_std_raw = jnp.split(x, 2, axis=-1)
+    log_std = log_std_min + 0.5 * (log_std_max - log_std_min) * (
+        jnp.tanh(log_std_raw) + 1.0
+    )
+    return mean, log_std
+
+
 def critic_init(
     key,
     obs_dim: int,

@@ -154,6 +154,9 @@ def supported(config: DDPGConfig) -> bool:
         config.action_insert_layer == 1
         and config.critic_l2 == 0.0
         and not config.fused_update
+        # SAC runs the scan path: its stochastic head + temperature scalar
+        # have no kernel branch yet (docs/OPERATIONS.md family table).
+        and not config.sac
         and config.compute_dtype in ("float32", "bfloat16")
         # The hand-written backward assumes the action-insert layer (1) is
         # not the critic's output layer, i.e. at least 2 hidden layers.

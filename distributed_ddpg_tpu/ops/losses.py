@@ -144,6 +144,115 @@ def td3_actor_loss(
 
 
 # ---------------------------------------------------------------------------
+# SAC (arXiv 1801.01290 / 1812.05905)
+# ---------------------------------------------------------------------------
+
+_TANH_EPS = 1e-6
+
+
+def sac_sample(mean, log_std, key, action_scale, action_offset=0.0):
+    """Reparameterized tanh-Gaussian sample mapped onto the action box.
+
+    Returns (action[B, A], log_prob[B]). log_prob folds the standard tanh
+    change-of-variables correction PLUS the box scaling's -log(scale) per
+    dim (the policy density lives in environment action units, so the
+    entropy target -act_dim means "one nat below a unit-box uniform per
+    dim" regardless of the env's scale). Gradients flow through `mean` and
+    `log_std` (reparameterization); callers stop-gradient where the
+    pathwise term is unwanted."""
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape)
+    tanh_u = jnp.tanh(u)
+    action = tanh_u * action_scale + action_offset
+    # N(u; mean, std) log-density, summed over action dims.
+    gauss_lp = -0.5 * (
+        jnp.square((u - mean) / std) + 2.0 * log_std + jnp.log(2.0 * jnp.pi)
+    )
+    # d(action)/d(u) = scale * (1 - tanh(u)^2); log|det| subtracts.
+    squash = jnp.log(action_scale * (1.0 - jnp.square(tanh_u)) + _TANH_EPS)
+    log_prob = jnp.sum(gauss_lp - squash, axis=-1)
+    return action, log_prob
+
+
+def sac_critic_loss(
+    critic_params,
+    actor_params,
+    target_critic_params,
+    batch: Batch,
+    action_scale,
+    key,
+    alpha,
+    log_std_min: float,
+    log_std_max: float,
+    action_insert_layer: int = 1,
+    l2: float = 0.0,
+    action_offset=0.0,
+    mm_dtype=None,
+):
+    """Entropy-regularized clipped double-Q TD loss:
+    y = r + discount * (min_i Q'_i(s', a') - alpha * log pi(a'|s')),
+    a' ~ pi(.|s') drawn from the CURRENT actor (SAC has no target actor).
+    `critic_params` leaves carry the same leading ensemble axis of 2 as
+    TD3's (learner.init_train_state). Returns (loss, td_proxy[B]) with the
+    ensemble-mean TD error as the PER priority proxy."""
+    from distributed_ddpg_tpu.models.mlp import actor_gaussian_apply
+
+    mean, log_std = actor_gaussian_apply(
+        actor_params, batch.next_obs, log_std_min, log_std_max, mm_dtype
+    )
+    next_action, next_lp = sac_sample(mean, log_std, key, action_scale, action_offset)
+    ensemble = lambda p, o, a: jax.vmap(
+        lambda cp: critic_apply(cp, o, a, action_insert_layer, mm_dtype)
+    )(p)
+    next_q = jnp.min(
+        ensemble(target_critic_params, batch.next_obs, next_action), axis=0
+    )
+    y = jax.lax.stop_gradient(td_targets(batch, next_q - alpha * next_lp))
+    q = ensemble(critic_params, batch.obs, batch.action)  # [2, B]
+    td = y[None, :] - q
+    loss = jnp.mean(batch.weight[None, :] * jnp.square(td))
+    if l2 > 0.0:
+        # Weight decay over both ensemble members (matching td3_critic_loss).
+        loss = loss + l2 * sum(
+            jnp.sum(jnp.square(layer["w"])) for layer in critic_params
+        )
+    return loss, jnp.mean(td, axis=0)
+
+
+def sac_actor_loss(
+    actor_params,
+    critic_params,
+    batch: Batch,
+    action_scale,
+    key,
+    alpha,
+    log_std_min: float,
+    log_std_max: float,
+    action_insert_layer: int = 1,
+    action_offset=0.0,
+    mm_dtype=None,
+):
+    """Reparameterized actor objective E[alpha * log pi(a|s) - min_i Q_i(s, a)].
+
+    Unlike TD3 (critic 0 only), SAC minimizes against the ensemble MIN —
+    the 1812.05905 convention. Returns (loss, mean_log_prob) — the aux
+    feeds the alpha (temperature) update."""
+    from distributed_ddpg_tpu.models.mlp import actor_gaussian_apply
+
+    mean, log_std = actor_gaussian_apply(
+        actor_params, batch.obs, log_std_min, log_std_max, mm_dtype
+    )
+    action, lp = sac_sample(mean, log_std, key, action_scale, action_offset)
+    q = jnp.min(
+        jax.vmap(
+            lambda cp: critic_apply(cp, batch.obs, action, action_insert_layer, mm_dtype)
+        )(critic_params),
+        axis=0,
+    )
+    return jnp.mean(alpha * lp - q), jnp.mean(lp)
+
+
+# ---------------------------------------------------------------------------
 # Distributional critic (D4PG)
 # ---------------------------------------------------------------------------
 

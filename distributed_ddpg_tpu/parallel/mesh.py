@@ -95,6 +95,14 @@ def state_pspec(state: TrainState, mesh: Mesh) -> TrainState:
         actor_opt=OptState(mu=actor, nu=actor, count=P()),
         critic_opt=OptState(mu=critic, nu=critic, count=P()),
         step=P(),
+        # SAC temperature scalars replicate; None (non-SAC) is an empty
+        # pytree node and needs no spec.
+        log_alpha=None if state.log_alpha is None else P(),
+        alpha_opt=(
+            None
+            if state.alpha_opt is None
+            else OptState(mu=P(), nu=P(), count=P())
+        ),
     )
 
 

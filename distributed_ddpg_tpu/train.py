@@ -161,7 +161,7 @@ def train_native(config: DDPGConfig) -> Dict[str, float]:
 def train_ondevice(config: DDPGConfig) -> Dict[str, float]:
     import jax
 
-    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
+    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, actor_head_dim, flatten_params, param_layout
     from distributed_ddpg_tpu.ondevice import OnDeviceDDPG
     from distributed_ddpg_tpu.parallel import multihost
 
@@ -202,9 +202,14 @@ def train_ondevice(config: DDPGConfig) -> Dict[str, float]:
 
     spec = _jax_env_spec(trainer)
     eval_policy = NumpyPolicy(
-        param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)),
+        param_layout(
+            spec.obs_dim,
+            actor_head_dim(spec.act_dim, config.sac),
+            tuple(config.actor_hidden),
+        ),
         spec.action_scale,
         spec.action_offset,
+        gaussian=config.sac,
     )
     profile_cm = (
         jax.profiler.trace(config.profile_dir)
@@ -333,7 +338,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
 def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> Dict[str, float]:
     import jax
 
-    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, flatten_params, param_layout
+    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, actor_head_dim, flatten_params, param_layout
     from distributed_ddpg_tpu.actors.pool import ActorPool
     from distributed_ddpg_tpu.parallel import multihost
     from distributed_ddpg_tpu.parallel.learner import (
@@ -424,6 +429,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         )
         learner.state = jax.device_put(restored, learner._state_sharding)
         learn_steps = step
+        # Resumed progress counts against the uniform-warmup budget
+        # (pool._spawn) — no random-action re-injection mid-training.
+        pool.env_steps_offset = env_steps_offset
         print(
             f"resumed from {config.checkpoint_dir} at learner step {step}, "
             f"env step {env_steps_offset}"
@@ -437,9 +445,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     saver = ckpt_lib.AsyncSaver()
     last_ckpt = learn_steps
     eval_policy = NumpyPolicy(
-        param_layout(spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)),
+        param_layout(
+            spec.obs_dim,
+            actor_head_dim(spec.act_dim, config.sac),
+            tuple(config.actor_hidden),
+        ),
         spec.action_scale,
         spec.action_offset,
+        gaussian=config.sac,
     )
 
     # Periodic eval runs in a background thread on a PARAM SNAPSHOT
@@ -460,10 +473,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         def _run():
             policy = NumpyPolicy(
                 param_layout(
-                    spec.obs_dim, spec.act_dim, tuple(config.actor_hidden)
+                    spec.obs_dim,
+                    actor_head_dim(spec.act_dim, config.sac),
+                    tuple(config.actor_hidden),
                 ),
                 spec.action_scale,
                 spec.action_offset,
+                gaussian=config.sac,
             )
             policy.load_flat(flat)
             log.log("eval", at_step, eval_return=_eval_numpy(policy, config, spec))

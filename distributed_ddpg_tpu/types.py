@@ -35,7 +35,13 @@ class OptState(NamedTuple):
 
 
 class TrainState(NamedTuple):
-    """Everything owned by the learner, as one donated pytree."""
+    """Everything owned by the learner, as one donated pytree.
+
+    log_alpha/alpha_opt exist only for the SAC family (learned entropy
+    temperature). They default to None — which JAX treats as an EMPTY
+    pytree node — so every non-SAC TrainState keeps its exact historical
+    leaf structure: checkpoints, sharding-spec trees, and tree.maps all
+    compose unchanged."""
 
     actor_params: Any
     critic_params: Any
@@ -44,6 +50,8 @@ class TrainState(NamedTuple):
     actor_opt: OptState
     critic_opt: OptState
     step: Any         # i32
+    log_alpha: Any = None   # f32 scalar (SAC only)
+    alpha_opt: Any = None   # OptState over log_alpha (SAC autotune only)
 
 
 def batch_from_numpy(arrays: Dict[str, np.ndarray]) -> Batch:

@@ -1,0 +1,28 @@
+#!/bin/bash
+# SAC quality evidence (docs/EVIDENCE.md §3 family table):
+#  1. Pendulum-v1 solve through the full train_jax stack (the rung-1-style
+#     gate every family gets), and
+#  2. HalfCheetah-v4 at the §7 gap-run topology (1 actor, 1:1 gating,
+#     300k steps, seed 0) so the SAC point is directly comparable to the
+#     committed DDPG (4793) and TD3 (4917) curves.
+# Classic SAC hyperparameters (1812.05905): lr 3e-4 everywhere, tau 5e-3.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+set -x
+python -m distributed_ddpg_tpu.train \
+  --backend=jax_tpu --sac=true --env_id=Pendulum-v1 --num_actors=4 \
+  --actor_hidden=64,64 --critic_hidden=64,64 \
+  --actor_lr=3e-4 --critic_lr=3e-4 --tau=0.005 \
+  --total_env_steps=60000 --replay_min_size=1000 --replay_capacity=100000 \
+  --max_learn_ratio=1 --max_ingest_ratio=1 \
+  --eval_every=10000 --eval_episodes=3 --seed=0 --watchdog_s=600 \
+  --log_path=runs/r4_sac_pendulum.jsonl || exit 1
+python -m distributed_ddpg_tpu.train \
+  --backend=jax_tpu --sac=true --env_id=HalfCheetah-v4 --num_actors=1 \
+  --actor_lr=3e-4 --critic_lr=3e-4 --tau=0.005 \
+  --total_env_steps=300000 --replay_min_size=10000 \
+  --max_learn_ratio=1 --max_ingest_ratio=1 \
+  --eval_every=30000 --eval_episodes=3 --seed=0 --watchdog_s=600 \
+  --log_path=runs/r4_sac_cheetah.jsonl || exit 1
+echo SAC_CURVES_DONE

@@ -54,6 +54,10 @@ def test_learner_chunk_resolution():
         DDPGConfig(max_learn_ratio=0.5, max_ingest_ratio=0.5)
     DDPGConfig(max_learn_ratio=1.0, max_ingest_ratio=1.0)
     DDPGConfig(max_learn_ratio=1.0, max_ingest_ratio=50.0)
+    # Staleness-sweep experiment knob (worker-side env-production brake).
+    DDPGConfig(actor_throttle_s=0.25)
+    with pytest.raises(ValueError, match="actor_throttle_s"):
+        DDPGConfig(actor_throttle_s=-0.1)
 
 
 @pytest.mark.slow
