@@ -50,6 +50,13 @@ class ActorPool:
         self.spec = spec
         self.num_actors = num_actors or config.num_actors
         self.heartbeat_timeout = heartbeat_timeout
+        if config.actor_throttle_s >= heartbeat_timeout:
+            raise ValueError(
+                f"actor_throttle_s={config.actor_throttle_s} >= the pool's "
+                f"heartbeat timeout ({heartbeat_timeout}s): the throttle "
+                "sleep sits between heartbeat stamps, so the monitor would "
+                "respawn every worker forever"
+            )
         self._ctx = mp.get_context("spawn")
         self.layout = param_layout(
             spec.obs_dim,

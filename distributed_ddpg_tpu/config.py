@@ -302,12 +302,10 @@ class DDPGConfig:
                 "alpha scalar rides the same path), not the fused_update "
                 "kernel"
             )
-        if self.sac and self.backend not in ("jax_tpu",):
+        if self.sac and self.backend == "native":
             raise ValueError(
-                "sac requires backend='jax_tpu': the native numpy learner is "
-                "the plain-DDPG bit-comparability oracle, and the ondevice "
-                "fused program acts deterministically (stochastic on-device "
-                "acting is not wired yet)"
+                "sac requires a JAX backend: the native numpy learner is "
+                "the plain-DDPG bit-comparability oracle"
             )
         if self.sac_alpha <= 0:
             raise ValueError("sac_alpha must be > 0 (it is exp(log_alpha))")

@@ -98,6 +98,13 @@ class OnDeviceDDPG:
                 "jax_ondevice backend runs one learner step per vector env "
                 "step (train_every is a host-loop knob; use --backend=jax_tpu)"
             )
+        if config.resolved_warmup_uniform() >= config.replay_capacity:
+            raise ValueError(
+                "warmup_uniform_steps must be < replay_capacity on "
+                "jax_ondevice: the warmup gate reads the ring-fill counter, "
+                "which saturates at capacity — a larger budget would act "
+                "uniformly forever"
+            )
         self.config = config
         self.env = make_jax_env(config.env_id)
         self.num_envs = int(config.num_actors)
@@ -142,21 +149,57 @@ class OnDeviceDDPG:
         env_axis = "data" if E % data_size == 0 else None
         env_spec = P(env_axis)
 
+        warmup_uniform = cfg.resolved_warmup_uniform()
+
         def env_step(carry: Carry):
-            key, k_ou, k_env = jax.random.split(carry.key, 3)
-            ou = (
-                carry.ou
-                + cfg.ou_theta * (0.0 - carry.ou) * cfg.ou_dt
-                + cfg.ou_sigma
-                * jnp.sqrt(cfg.ou_dt)
-                * jax.random.normal(k_ou, carry.ou.shape, jnp.float32)
-            )
-            action = jnp.clip(
-                actor_apply(carry.train.actor_params, carry.obs, scale, offset)
-                + ou * scale,
-                low,
-                high,
-            )
+            key, k_ou, k_env, k_uni = jax.random.split(carry.key, 4)
+            if cfg.sac:
+                # SAC explores by sampling its own tanh-Gaussian on device;
+                # the OU state rides along as zeros. Uniform warmup
+                # (config.warmup_uniform_steps) is a jnp.where on the ring
+                # fill — no separate compiled warmup program.
+                from distributed_ddpg_tpu.models.mlp import actor_gaussian_apply
+                from distributed_ddpg_tpu.ops import losses as losses_lib
+
+                mean, log_std = actor_gaussian_apply(
+                    carry.train.actor_params,
+                    carry.obs,
+                    cfg.sac_log_std_min,
+                    cfg.sac_log_std_max,
+                )
+                sampled, _ = losses_lib.sac_sample(
+                    mean, log_std, k_ou, scale, offset
+                )
+                action = jnp.clip(sampled, low, high)
+                ou = carry.ou
+            else:
+                ou = (
+                    carry.ou
+                    + cfg.ou_theta * (0.0 - carry.ou) * cfg.ou_dt
+                    + cfg.ou_sigma
+                    * jnp.sqrt(cfg.ou_dt)
+                    * jax.random.normal(k_ou, carry.ou.shape, jnp.float32)
+                )
+                action = jnp.clip(
+                    actor_apply(carry.train.actor_params, carry.obs, scale, offset)
+                    + ou * scale,
+                    low,
+                    high,
+                )
+            if warmup_uniform > 0:
+                # Uniform warmup for EVERY family (worker.py parity; auto
+                # resolves >0 only for SAC, but an explicit
+                # warmup_uniform_steps must mean the same thing on every
+                # backend). Gate on the ring fill — valid because __init__
+                # rejects warmup >= capacity (size saturates there).
+                action = jnp.where(
+                    carry.size < warmup_uniform,
+                    jax.random.uniform(
+                        k_uni, action.shape, jnp.float32,
+                        minval=low, maxval=high,
+                    ),
+                    action,
+                )
             out = jax.vmap(env.step)(
                 carry.env_state, action, jax.random.split(k_env, E)
             )

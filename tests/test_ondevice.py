@@ -202,6 +202,22 @@ def test_ondevice_episode_returns_and_replay_roundtrip():
     )
 
 
+def test_ondevice_warmup_gates():
+    """The ring-fill warmup gate saturates at capacity, so an over-budget
+    warmup must be rejected; warmup also applies to OU families when set
+    explicitly (worker.py parity)."""
+    from distributed_ddpg_tpu.ondevice import OnDeviceDDPG
+
+    with pytest.raises(ValueError, match="warmup_uniform_steps"):
+        OnDeviceDDPG(
+            _tiny_config(warmup_uniform_steps=8192), chunk_size=4
+        )  # capacity 4096
+    trainer = OnDeviceDDPG(
+        _tiny_config(warmup_uniform_steps=64), chunk_size=4
+    )
+    trainer.run_chunk()  # traces with the where-branch active
+
+
 def test_ondevice_rejects_per_and_nstep():
     from distributed_ddpg_tpu.ondevice import OnDeviceDDPG
 
@@ -221,6 +237,9 @@ def test_ondevice_runs_all_families():
     for extra in (
         dict(twin_critic=True, policy_delay=2, target_noise=0.2),
         dict(distributional=True, num_atoms=21, v_min=-200.0, v_max=200.0),
+        # SAC: on-device tanh-Gaussian sampling + jnp.where uniform warmup
+        # + the temperature scalar riding the donated carry.
+        dict(sac=True, warmup_uniform_steps=32),
     ):
         trainer = OnDeviceDDPG(_tiny_config(**extra), chunk_size=4)
         for _ in range(4):
@@ -228,3 +247,9 @@ def test_ondevice_runs_all_families():
         host = trainer.finalize_stats(stats)
         assert np.isfinite(host["critic_loss"])
         assert trainer.learn_steps > 0
+        if extra.get("sac"):
+            import jax as _jax
+
+            assert np.isfinite(
+                float(_jax.device_get(trainer.state.log_alpha))
+            )

@@ -227,6 +227,14 @@ def test_sac_warmup_uniform_resolution_and_acting():
     assert _cfg(warmup_uniform_steps=0).resolved_warmup_uniform() == 0
     with pytest.raises(ValueError, match="warmup_uniform_steps"):
         DDPGConfig(warmup_uniform_steps=-2)
+    # A throttle at/above the pool's heartbeat timeout would respawn-loop
+    # every worker (the sleep sits between heartbeat stamps).
+    from distributed_ddpg_tpu.actors.pool import ActorPool as _AP
+    from distributed_ddpg_tpu.envs import make as _make, spec_of as _spec_of
+
+    _s = _spec_of(_make("Pendulum-v1", seed=0, prefer_builtin=True))
+    with pytest.raises(ValueError, match="heartbeat"):
+        _AP(DDPGConfig(actor_throttle_s=35.0), _s, heartbeat_timeout=30.0)
 
     cfg = _cfg(
         env_id="Pendulum-v1", replay_min_size=200, warmup_uniform_steps=200,
@@ -282,8 +290,8 @@ def test_sac_config_gates():
         DDPGConfig(sac=True, fused_update=True)
     with pytest.raises(ValueError, match="backend"):
         DDPGConfig(sac=True, backend="native")
-    with pytest.raises(ValueError, match="backend"):
-        DDPGConfig(sac=True, backend="jax_ondevice")
+    # ondevice composes (tests/test_ondevice.py::test_ondevice_runs_all_families).
+    DDPGConfig(sac=True, backend="jax_ondevice")
     with pytest.raises(ValueError, match="sac_alpha"):
         DDPGConfig(sac=True, sac_alpha=0.0)
     with pytest.raises(ValueError, match="log_std"):
