@@ -372,6 +372,10 @@ def phase_study() -> dict:
          base.replace(fused_chunk=m, twin_critic=True,
                       policy_delay=2, target_noise=0.2))
         for m in ("auto", "off")
+    ] + [
+        (f"sac_{'fused' if m == 'auto' else 'scan'}",
+         base.replace(fused_chunk=m, sac=True))
+        for m in ("auto", "off")
     ]
     points = {}
     for key, config in grid:

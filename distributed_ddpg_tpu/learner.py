@@ -234,35 +234,14 @@ def make_learner_step(
         if config.sac_autotune:
             # J(log_alpha) = -log_alpha * (E[log pi] + target_H);
             # d/dlog_alpha = -(E[log pi] + target_H), exact — no autodiff
-            # needed for a scalar with a linear objective. target_entropy
-            # nan = auto: the 1812.05905 heuristic -act_dim is stated for
-            # UNIT-box log-probs; ours live in env units (sac_sample folds
-            # -log(scale) per dim), so the equivalent target shifts by
-            # +sum(log scale) — without the shift, any env with scale > 1
-            # gets a LOWER-entropy target than standard SAC and alpha
-            # collapses (measured on Pendulum, scale 2: alpha -> 0.017 and
-            # stuck; shifted target matches standard behavior). act_dim is
-            # static under jit from the batch's action shape.
-            import math
-
-            if not math.isnan(config.target_entropy):
-                tgt_h = config.target_entropy
-            else:
-                import numpy as np
-
-                a_dim = batch.action.shape[-1]
-                # Plain numpy on the closure's host-side action_scale: the
-                # target is a trace-time Python constant (jnp here would
-                # yield a tracer under jit).
-                tgt_h = -float(a_dim) + float(
-                    np.sum(
-                        np.log(
-                            np.broadcast_to(
-                                np.asarray(action_scale, np.float64), (a_dim,)
-                            )
-                        )
-                    )
-                )
+            # needed for a scalar with a linear objective. The target
+            # resolution (explicit value vs the env-unit-shifted -act_dim
+            # heuristic) lives in losses.sac_target_entropy, shared with
+            # the fused kernel wrapper. act_dim is static under jit from
+            # the batch's action shape.
+            tgt_h = losses.sac_target_entropy(
+                config.target_entropy, batch.action.shape[-1], action_scale
+            )
             alpha_grad = -(jax.lax.stop_gradient(mean_lp) + tgt_h)
             new_log_alpha, alpha_opt = adam_update(
                 state.log_alpha, alpha_grad, state.alpha_opt, config.critic_lr

@@ -33,6 +33,16 @@ lower/upper-neighbor mass split, rank-2 throughout so Mosaic never sees a
 cotangents (softmax(logits) - proj for the critic CE; -p * (z - E[Z]) / B
 for the actor's expected-value head).
 
+SAC (ops/losses.py sac_critic_loss / sac_actor_loss semantics) runs in the
+same kernel too: the Gaussian head's [mean | log_std] split, the tanh
+soft-clamp of log_std, reparameterized sampling (the per-step standard
+normals stream in pre-drawn from the scan path's exact fold_in key stream,
+like TD3's smoothing noise), the tanh-squash log-prob, the entropy-
+corrected twin-critic TD target, and the learned temperature's scalar Adam
+all execute in-kernel; the hand-written actor backward routes the min-Q
+gate with reduce_min's tie-splitting vjp and chains d(log pi)/du =
+2*scale*t*(1-t^2)/g through the squash correction.
+
 Mixed precision (config.compute_dtype='bfloat16') casts matmul operands to
 bf16 with f32 accumulation (`preferred_element_type`), forward AND backward,
 mirroring models/mlp._dense; params, Adam state, and activations stay f32.
@@ -62,6 +72,9 @@ from distributed_ddpg_tpu.types import TrainState, OptState
 
 _LOG_B1 = math.log(B1)
 _LOG_B2 = math.log(B2)
+_LOG_2PI = math.log(2.0 * math.pi)
+# Tanh-squash log-det guard — MUST match losses._TANH_EPS for parity.
+_TANH_EPS = 1e-6
 
 # Fixed order in which a params tree (tuple of {"w","b"} dicts) is flattened
 # into the kernel's ref list: w0, b0, w1, b1, ...  Biases ride as (1, F) rows
@@ -131,11 +144,13 @@ def state_vmem_bytes(config: DDPGConfig, obs_dim: int, act_dim: int) -> int:
     # obs/act enter the actor/critic input dims; action rides into critic
     # layer 1 (action_insert_layer == 1 inside the supported envelope).
     # The C51 head widens the critic output to num_atoms logits; the TD3
-    # twin ensemble doubles every critic tensor.
+    # twin ensemble doubles every critic tensor; SAC doubles both the
+    # actor head ([mean | log_std]) and the critic (its own ensemble).
     out = config.num_atoms if config.distributional else 1
-    a = net([obs_dim, *config.actor_hidden, act_dim])
+    head = 2 * act_dim if config.sac else act_dim
+    a = net([obs_dim, *config.actor_hidden, head])
     c = net([obs_dim, *config.critic_hidden, out], extra_in=act_dim)
-    if config.twin_critic:
+    if config.twin_critic or config.sac:
         c *= 2
     return 4 * (4 * a + 4 * c)
 
@@ -154,9 +169,6 @@ def supported(config: DDPGConfig) -> bool:
         config.action_insert_layer == 1
         and config.critic_l2 == 0.0
         and not config.fused_update
-        # SAC runs the scan path: its stochastic head + temperature scalar
-        # have no kernel branch yet (docs/OPERATIONS.md family table).
-        and not config.sac
         and config.compute_dtype in ("float32", "bfloat16")
         # The hand-written backward assumes the action-insert layer (1) is
         # not the critic's output layer, i.e. at least 2 hidden layers.
@@ -172,8 +184,13 @@ def _sq(tree_leaves) -> Any:
     return sum(jnp.sum(x * x) for x in tree_leaves)
 
 
-def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
-    """Builds the kernel body. n_actor/n_critic = number of linear layers."""
+def _make_kernel(
+    n_actor: int, n_critic: int, batch: int, chunk: int, config,
+    sac_target_entropy: float | None = None,
+):
+    """Builds the kernel body. n_actor/n_critic = number of linear layers.
+    `sac_target_entropy` is the trace-time scalar the wrapper resolves with
+    the scan path's exact rule (learner.make_learner_step sac_step)."""
     tau = float(config.tau)
     lr_a = float(config.actor_lr)
     lr_c = float(config.critic_lr)
@@ -187,8 +204,16 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
     twin = bool(config.twin_critic)
     policy_delay = int(config.policy_delay)
     has_noise = twin and config.target_noise > 0.0
-    # Per-member critic ref count vs the total across the TD3 ensemble.
-    nct = nc2 * (2 if twin else 1)
+    sac = bool(config.sac)
+    autotune = sac and bool(config.sac_autotune)
+    # SAC log_std soft clamp: log_std = m0 + hw * (tanh(raw) + 1)
+    # (models/mlp.actor_gaussian_apply).
+    m0 = float(config.sac_log_std_min)
+    hw = 0.5 * (float(config.sac_log_std_max) - m0)
+    # Per-member critic ref count vs the total across the TD3/SAC ensemble.
+    nct = nc2 * (2 if (twin or sac) else 1)
+    # Resident temperature refs: log_alpha, plus its Adam mu/nu when learned.
+    n_alpha = (3 if autotune else 1) if sac else 0
 
     # Mixed precision: cast matmul operands to bf16, accumulate f32 —
     # forward and backward alike (mirrors models/mlp._dense). Everything
@@ -228,12 +253,17 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
             (z_ref,) = take(1)  # categorical support, (1, num_atoms)
         if has_noise:
             (eps_r,) = take(1)  # target-smoothing noise stream, [K, B, act]
+        if sac:
+            # Pre-drawn standard normals: critic-target draw a'~pi(.|s')
+            # and actor-pass draw a~pi(.|s), one [K, B, act] stream each.
+            eps_next_r, eps_cur_r = take(2)
         actor_in = take(na2)
         critic_in = take(nct)
         t_actor_in = take(na2)
         t_critic_in = take(nct)
         amu_in, anu_in = take(na2), take(na2)
         cmu_in, cnu_in = take(nct), take(nct)
+        alpha_in = take(n_alpha)
         td_out, met_out = take(2)
         actor_o = take(na2)
         critic_o = take(nct)
@@ -241,11 +271,15 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
         t_critic_o = take(nct)
         amu_o, anu_o = take(na2), take(na2)
         cmu_o, cnu_o = take(nct), take(nct)
+        alpha_o = take(n_alpha)
 
         def cm(group, m):
             """Member m's ref slice of a critic group (whole group when not
-            twin — the ensemble axis was flattened into the ref list)."""
-            return group[m * nc2 : (m + 1) * nc2] if twin else group
+            an ensemble — the ensemble axis was flattened into the ref
+            list)."""
+            return (
+                group[m * nc2 : (m + 1) * nc2] if (twin or sac) else group
+            )
 
         k = pl.program_id(0)
 
@@ -256,9 +290,9 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
         def _seed():
             for src, dst in zip(
                 actor_in + critic_in + t_actor_in + t_critic_in
-                + amu_in + anu_in + cmu_in + cnu_in,
+                + amu_in + anu_in + cmu_in + cnu_in + alpha_in,
                 actor_o + critic_o + t_actor_o + t_critic_o
-                + amu_o + anu_o + cmu_o + cnu_o,
+                + amu_o + anu_o + cmu_o + cnu_o + alpha_o,
             ):
                 dst[...] = src[...]
 
@@ -336,6 +370,203 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
             grads[0] = _dW(acts[0], dz0)
             grads[1] = jnp.sum(dz0, axis=0, keepdims=True)
             return grads, da
+
+        def mlp_bwd(group, acts, dz):
+            """Plain-MLP backward from the output-layer cotangent dz
+            ([B, out]); returns param grads aligned with the group order.
+            Shared by the deterministic actor (after its tanh chain) and
+            the SAC Gaussian head (whose output layer is linear)."""
+            grads = [None] * na2
+            grads[2 * (n_actor - 1)] = _dW(acts[n_actor - 1], dz)
+            grads[2 * (n_actor - 1) + 1] = jnp.sum(dz, axis=0, keepdims=True)
+            for i in range(n_actor - 2, -1, -1):
+                dh = _dx(dz, W(group, i + 1))
+                dz = dh * (acts[i + 1] > 0.0)
+                grads[2 * i] = _dW(acts[i], dz)
+                grads[2 * i + 1] = jnp.sum(dz, axis=0, keepdims=True)
+            return grads
+
+        def adam_only(n2, p_o, mu_o, nu_o, grads, lr, t_step):
+            # B^t as exp(t*log(B)) — Mosaic has no powf with a traced
+            # exponent (fails to legalize 'math.powf' on real TPU).
+            bc1 = 1.0 - jnp.exp(t_step * jnp.float32(_LOG_B1))
+            bc2 = 1.0 - jnp.exp(t_step * jnp.float32(_LOG_B2))
+            for j in range(n2):
+                g = grads[j]
+                m = B1 * mu_o[j][...] + (1.0 - B1) * g
+                v = B2 * nu_o[j][...] + (1.0 - B2) * (g * g)
+                mu_o[j][...] = m
+                nu_o[j][...] = v
+                p_o[j][...] = p_o[j][...] - lr * (m / bc1) / (
+                    jnp.sqrt(v / bc2) + EPS
+                )
+
+        def polyak_only(n2, p_o, t_o):
+            for j in range(n2):
+                t_o[j][...] = tau * p_o[j][...] + (1.0 - tau) * t_o[j][...]
+
+        def emit(td, step_metrics):
+            """Write the per-step TD block and accumulate the chunk-MEAN
+            metrics into the revisited (1, len(METRIC_KEYS)) block — see
+            the layout rationale in the DDPG tail below."""
+            td_out[0] = td
+            assert len(step_metrics) == met_out.shape[-1]
+            vals = jnp.stack(step_metrics).reshape(1, -1) * inv_k
+
+            @pl.when(k == 0)
+            def _met_seed():
+                met_out[...] = vals
+
+            @pl.when(k > 0)
+            def _met_acc():
+                met_out[...] = met_out[...] + vals
+
+        if sac:
+            # ==== SAC branch (losses.sac_critic_loss / sac_actor_loss ====
+            # ==== + learner.sac_step semantics), then early return     ====
+            A = scale.shape[-1]
+
+            def gauss_fwd(group, x):
+                """Gaussian head: relu MLP, linear [mean | log_std_raw]
+                output, tanh soft-clamp of log_std onto [min, max]
+                (models/mlp.actor_gaussian_apply). Returns
+                (mean, log_std, tr, acts) with tr = tanh(raw) cached for
+                the clamp's backward."""
+                acts = [x]
+                for i in range(n_actor - 1):
+                    z = _mm(acts[-1], W(group, i)) + Bv(group, i)
+                    acts.append(jnp.maximum(z, 0.0))
+                zL = _mm(acts[-1], W(group, n_actor - 1)) + Bv(
+                    group, n_actor - 1
+                )
+                mean = zL[:, :A]
+                tr = jnp.tanh(zL[:, A:])
+                log_std = m0 + hw * (tr + 1.0)
+                return mean, log_std, tr, acts
+
+            def sample(mean, log_std, eps):
+                """Reparameterized tanh-Gaussian draw + log-prob
+                (losses.sac_sample with the normal pre-drawn): because
+                u = mean + std*eps, (u-mean)/std == eps exactly, so the
+                Gaussian term needs no u."""
+                std = jnp.exp(log_std)
+                u = mean + std * eps
+                t = jnp.tanh(u)
+                a_env = t * scale + offset
+                g = scale * (1.0 - t * t) + _TANH_EPS
+                lp_dim = (
+                    -0.5 * (eps * eps) - log_std - 0.5 * _LOG_2PI
+                    - jnp.log(g)
+                )
+                lp = jnp.sum(lp_dim, axis=-1, keepdims=True)  # [B, 1]
+                return std, t, a_env, g, lp
+
+            la = alpha_o[0][...]  # (1, 1) resident log_alpha
+            alpha = jnp.exp(la[0, 0])
+
+            # ---- critic update: y = r + disc*(minQ' - alpha*logpi') ----
+            meanN, log_stdN, _, _ = gauss_fwd(actor_o, nobs)
+            _, _, aN, _, lpN = sample(meanN, log_stdN, eps_next_r[0])
+            qt0, _ = critic_fwd(cm(t_critic_o, 0), nobs, aN)
+            qt1, _ = critic_fwd(cm(t_critic_o, 1), nobs, aN)
+            y = rew + disc * (jnp.minimum(qt0, qt1) - alpha * lpN)
+            q0, acts0 = critic_fwd(cm(critic_o, 0), obs, action)
+            q1_, acts1 = critic_fwd(cm(critic_o, 1), obs, action)
+            td0 = y - q0
+            td1 = y - q1_
+            td = 0.5 * (td0 + td1)  # PER proxy: ensemble-mean TD
+            # L = mean over [2, B] of w * td^2 -> dL/dq_m = -w * td_m / B.
+            closs = (
+                jnp.sum(wgt * td0 * td0) + jnp.sum(wgt * td1 * td1)
+            ) * (0.5 * inv_b)
+            c_grads0, _ = critic_bwd(
+                cm(critic_o, 0), acts0, action, (-inv_b) * wgt * td0,
+                wgrads=True,
+            )
+            c_grads1, _ = critic_bwd(
+                cm(critic_o, 1), acts1, action, (-inv_b) * wgt * td1,
+                wgrads=True,
+            )
+
+            # ---- actor update: L = E[alpha*logpi(a|s) - min_m Q_m(s,a)],
+            # a = tanh(mean + std*eps)*scale + offset, pre-update critics.
+            meanC, log_stdC, trC, a_acts = gauss_fwd(actor_o, obs)
+            epsC = eps_cur_r[0]
+            stdC, tC, aC, gC, lpC = sample(meanC, log_stdC, epsC)
+            q_pi0, pia0 = critic_fwd(cm(critic_o, 0), obs, aC)
+            q_pi1, pia1 = critic_fwd(cm(critic_o, 1), obs, aC)
+            qmin = jnp.minimum(q_pi0, q_pi1)
+            mean_lp = jnp.sum(lpC) * inv_b
+            aloss = alpha * mean_lp - jnp.sum(qmin) * inv_b
+            # Min gate with reduce_min's tie-splitting vjp (the scan path's
+            # jnp.min over the member axis): equal rows split the cotangent.
+            lt = (q_pi0 < q_pi1).astype(jnp.float32)
+            gt = (q_pi0 > q_pi1).astype(jnp.float32)
+            gate0 = lt + 0.5 * (1.0 - lt - gt)
+            gate1 = 1.0 - gate0
+            _, daA = critic_bwd(
+                cm(critic_o, 0), pia0, aC, (-inv_b) * gate0, wgrads=False
+            )
+            _, daB = critic_bwd(
+                cm(critic_o, 1), pia1, aC, (-inv_b) * gate1, wgrads=False
+            )
+            da = daA + daB
+            # d(logpi)/du through the squash correction: lp's Gaussian term
+            # is eps-only (see sample()), so only -log(g) carries u;
+            # d(-log g)/du = 2*scale*t*(1-t^2)/g. The action path adds
+            # da/du = scale*(1-t^2).
+            dlp_row = alpha * inv_b  # dL/dlp per row (actor loss mean)
+            one_m_t2 = 1.0 - tC * tC
+            du = da * scale * one_m_t2 + dlp_row * (
+                2.0 * scale * tC * one_m_t2 / gC
+            )
+            dmean = du  # du/dmean = 1
+            # dlp/dlog_std (direct) = -1 per dim; du/dlog_std = std*eps.
+            dlog_std = du * stdC * epsC - dlp_row
+            # Soft clamp backward: log_std = m0 + hw*(tanh(raw)+1).
+            draw = dlog_std * (hw * (1.0 - trC * trC))
+            dzL = jnp.concatenate([dmean, draw], axis=-1)  # [B, 2A]
+            a_grads = mlp_bwd(actor_o, a_acts, dzL)
+
+            # ---- Adam (critic, actor), Polyak (both targets — SAC's math
+            # has no target actor, but the slot trails for state parity
+            # with the scan path), temperature Adam when autotuned.
+            c_t = (count_ref[1] + k + 1).astype(jnp.float32)
+            adam_only(nc2, cm(critic_o, 0), cm(cmu_o, 0), cm(cnu_o, 0),
+                      c_grads0, lr_c, c_t)
+            adam_only(nc2, cm(critic_o, 1), cm(cmu_o, 1), cm(cnu_o, 1),
+                      c_grads1, lr_c, c_t)
+            a_t = (count_ref[0] + k + 1).astype(jnp.float32)
+            adam_only(na2, actor_o, amu_o, anu_o, a_grads, lr_a, a_t)
+            polyak_only(nct, critic_o, t_critic_o)
+            polyak_only(na2, actor_o, t_actor_o)
+            if autotune:
+                # J(log_alpha) = -log_alpha*(E[logpi]+H*): exact scalar
+                # gradient, Adam at critic_lr (learner.sac_step).
+                al_g = -(mean_lp + jnp.float32(sac_target_entropy))
+                al_t = (count_ref[3] + k + 1).astype(jnp.float32)
+                bc1 = 1.0 - jnp.exp(al_t * jnp.float32(_LOG_B1))
+                bc2 = 1.0 - jnp.exp(al_t * jnp.float32(_LOG_B2))
+                m_a = B1 * alpha_o[1][...] + (1.0 - B1) * al_g
+                v_a = B2 * alpha_o[2][...] + (1.0 - B2) * (al_g * al_g)
+                alpha_o[1][...] = m_a
+                alpha_o[2][...] = v_a
+                alpha_o[0][...] = la - lr_c * (m_a / bc1) / (
+                    jnp.sqrt(v_a / bc2) + EPS
+                )
+
+            emit(
+                td,
+                [
+                    closs,
+                    aloss,
+                    alpha * mean_lp - aloss,  # = E[minQ] (scan's mean_q)
+                    jnp.sum(jnp.abs(td)) * inv_b,
+                    jnp.sqrt(_sq(c_grads0) + _sq(c_grads1)),
+                    jnp.sqrt(_sq(a_grads)),
+                ],
+            )
+            return
 
         # Target path (no grads).
         u_t, _ = actor_fwd(t_actor_o, nobs)
@@ -444,43 +675,18 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
         _, da = critic_bwd(cm(critic_o, 0), pi_acts, u, dq_pi, wgrads=False)
 
         def actor_bwd(group, acts, t_out, da_in):
-            grads = [None] * na2
-            dz = da_in * scale * (1.0 - t_out * t_out)
-            grads[2 * (n_actor - 1)] = _dW(acts[n_actor - 1], dz)
-            grads[2 * (n_actor - 1) + 1] = jnp.sum(dz, axis=0, keepdims=True)
-            for i in range(n_actor - 2, -1, -1):
-                dh = _dx(dz, W(group, i + 1))
-                dz = dh * (acts[i + 1] > 0.0)
-                grads[2 * i] = _dW(acts[i], dz)
-                grads[2 * i + 1] = jnp.sum(dz, axis=0, keepdims=True)
-            return grads
+            # Chain through the tanh*scale output, then the shared MLP bwd.
+            return mlp_bwd(group, acts, da_in * scale * (1.0 - t_out * t_out))
 
         a_grads = actor_bwd(actor_o, a_acts, t_u, da)
 
         # ---- Adam + Polyak, all in VMEM ---------------------------------
-        # count_ref = [actor_count0, critic_count0, step0]: each net's bias
-        # correction follows ITS OWN carried Adam count (they only coincide
-        # when the TrainState has always stepped both nets together);
-        # step0 drives the TD3 delayed-update schedule.
-        def adam_only(n2, p_o, mu_o, nu_o, grads, lr, t_step):
-            # B^t as exp(t*log(B)) — Mosaic has no powf with a traced
-            # exponent (fails to legalize 'math.powf' on real TPU).
-            bc1 = 1.0 - jnp.exp(t_step * jnp.float32(_LOG_B1))
-            bc2 = 1.0 - jnp.exp(t_step * jnp.float32(_LOG_B2))
-            for j in range(n2):
-                g = grads[j]
-                m = B1 * mu_o[j][...] + (1.0 - B1) * g
-                v = B2 * nu_o[j][...] + (1.0 - B2) * (g * g)
-                mu_o[j][...] = m
-                nu_o[j][...] = v
-                p_o[j][...] = p_o[j][...] - lr * (m / bc1) / (
-                    jnp.sqrt(v / bc2) + EPS
-                )
-
-        def polyak_only(n2, p_o, t_o):
-            for j in range(n2):
-                t_o[j][...] = tau * p_o[j][...] + (1.0 - tau) * t_o[j][...]
-
+        # count_ref = [actor_count0, critic_count0, step0 (, alpha_count0
+        # for SAC autotune)]: each net's bias correction follows ITS OWN
+        # carried Adam count (they only coincide when the TrainState has
+        # always stepped both nets together); step0 drives the TD3
+        # delayed-update schedule. (adam_only/polyak_only are defined above
+        # the SAC branch, which returns early.)
         def apply(n2, p_o, t_o, mu_o, nu_o, grads, lr, count0):
             adam_only(
                 n2, p_o, mu_o, nu_o, grads, lr,
@@ -523,9 +729,8 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
                   count_ref[0])
 
         # ---- outputs -----------------------------------------------------
-        td_out[0] = td
         # Order must match learner.METRIC_KEYS; the wrapper sizes the metric
-        # block from len(METRIC_KEYS) and asserts this stack agrees.
+        # block from len(METRIC_KEYS) and emit() asserts this stack agrees.
         # The chunk MEAN is accumulated in-kernel into a (1, 6) output whose
         # block IS the whole array (constant index map) — a per-step (K, 6)
         # output would need a (1, 6) block over K rows, which violates
@@ -539,24 +744,17 @@ def _make_kernel(n_actor: int, n_critic: int, batch: int, chunk: int, config):
             a_norm = jnp.where(
                 ((count_ref[2] + k) % policy_delay) == 0, a_norm, 0.0
             )
-        step_metrics = [
-            closs,
-            aloss,
-            -aloss,
-            jnp.sum(jnp.abs(td)) * inv_b,
-            jnp.sqrt(_sq(c_grads)),
-            a_norm,
-        ]
-        assert len(step_metrics) == met_out.shape[-1]
-        vals = jnp.stack(step_metrics).reshape(1, -1) * inv_k
-
-        @pl.when(k == 0)
-        def _met_seed():
-            met_out[...] = vals
-
-        @pl.when(k > 0)
-        def _met_acc():
-            met_out[...] = met_out[...] + vals
+        emit(
+            td,
+            [
+                closs,
+                aloss,
+                -aloss,
+                jnp.sum(jnp.abs(td)) * inv_b,
+                jnp.sqrt(_sq(c_grads)),
+                a_norm,
+            ],
+        )
 
     return kernel
 
@@ -595,6 +793,38 @@ def td3_noise_eps(config: DDPGConfig, step0, chunk: int, batch: int,
             config.target_noise_clip,
         )
     )(keys)
+
+
+def sac_noise_base_key(config: DDPGConfig):
+    """The SAC sampling-noise base key. MUST stay identical to
+    learner.make_learner_step's sac_base_key for bit-comparability."""
+    return jax.random.PRNGKey(config.seed ^ 0x5AC0)
+
+
+def sac_noise_eps(config: DDPGConfig, step0, chunk: int, batch: int,
+                  act_dim: int, device_fold=None):
+    """Pre-draw a chunk's SAC standard normals: (eps_next, eps_cur), each
+    [K, B, act], from the scan path's exact stream — key =
+    fold_in(base, global_step) (then the device fold, mirroring the
+    axis_name fold in learner.sac_step), split into the critic-target draw
+    and the actor draw, `normal(key, (B, act))` each. Because
+    u = mean + std*eps with eps independent of params, streaming the
+    pre-drawn eps is exactly equivalent to sampling inside the step."""
+    base = sac_noise_base_key(config)
+    keys = jax.vmap(lambda s_: jax.random.fold_in(base, s_))(
+        step0 + jnp.arange(chunk)
+    )
+    if device_fold is not None:
+        keys = jax.vmap(lambda kk: jax.random.fold_in(kk, device_fold))(keys)
+
+    def draw(kk):
+        k_next, k_cur = jax.random.split(kk)
+        return (
+            jax.random.normal(k_next, (batch, act_dim)),
+            jax.random.normal(k_cur, (batch, act_dim)),
+        )
+
+    return jax.vmap(draw)(keys)
 
 
 def make_fused_chunk_fn(
@@ -642,6 +872,14 @@ def make_fused_chunk_fn(
     )
     twin = bool(config.twin_critic)
     has_noise = twin and config.target_noise > 0.0
+    sac = bool(config.sac)
+    autotune = sac and bool(config.sac_autotune)
+    if sac:
+        from distributed_ddpg_tpu.ops.losses import sac_target_entropy
+
+        tgt_h = sac_target_entropy(config.target_entropy, a, action_scale)
+    else:
+        tgt_h = None
 
     from distributed_ddpg_tpu.learner import METRIC_KEYS
 
@@ -657,7 +895,7 @@ def make_fused_chunk_fn(
         nobs = batches[..., o + a + 2 : 2 * o + a + 2]
         wgt = batches[..., 2 * o + a + 2 : 2 * o + a + 3]
 
-        flat_c = _flatten_twin if twin else _flatten
+        flat_c = _flatten_twin if (twin or sac) else _flatten
         state_flat = (
             _flatten(state.actor_params)
             + flat_c(state.critic_params)
@@ -668,6 +906,15 @@ def make_fused_chunk_fn(
             + flat_c(state.critic_opt.mu)
             + flat_c(state.critic_opt.nu)
         )
+        if sac:
+            # Resident temperature: log_alpha (+ its Adam moments when
+            # learned), as (1, 1) VMEM blocks like every other tensor.
+            state_flat = state_flat + [state.log_alpha.reshape(1, 1)]
+            if autotune:
+                state_flat = state_flat + [
+                    state.alpha_opt.mu.reshape(1, 1),
+                    state.alpha_opt.nu.reshape(1, 1),
+                ]
 
         if has_noise and eps is None:
             # Pre-draw the whole chunk's smoothing noise [K, B, act] from
@@ -676,7 +923,11 @@ def make_fused_chunk_fn(
             # the minibatches (~KB per step). Callers with a device axis
             # (fused-mesh) pass their own axis-folded eps instead.
             eps = td3_noise_eps(config, state.step, K, B, a)
-        elif not has_noise:
+        elif sac and eps is None:
+            # SAC: (eps_next, eps_cur) standard-normal streams, same
+            # fold_in discipline (sac_noise_eps docstring).
+            eps = sac_noise_eps(config, state.step, K, B, a)
+        elif not (has_noise or sac):
             eps = None
 
         def stream_spec(d):
@@ -696,7 +947,11 @@ def make_fused_chunk_fn(
                stream_spec(o), stream_spec(1)]
             + [pinned_spec(scale), pinned_spec(offset)]
             + ([pinned_spec(z_row)] if z_row is not None else [])
-            + ([stream_spec(a)] if eps is not None else [])
+            + (
+                [stream_spec(a), stream_spec(a)]
+                if sac
+                else ([stream_spec(a)] if eps is not None else [])
+            )
             + [pinned_spec(x) for x in state_flat]
         )
         out_specs = (
@@ -722,12 +977,18 @@ def make_fused_chunk_fn(
             + [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in state_flat]
         )
 
-        kernel = _make_kernel(n_actor, n_critic, B, K, config)
-        count0 = jnp.stack(
-            [state.actor_opt.count, state.critic_opt.count, state.step]
-        ).astype(jnp.int32)
+        kernel = _make_kernel(
+            n_actor, n_critic, B, K, config, sac_target_entropy=tgt_h
+        )
+        counts = [state.actor_opt.count, state.critic_opt.count, state.step]
+        if autotune:
+            counts.append(state.alpha_opt.count)
+        count0 = jnp.stack(counts).astype(jnp.int32)
         support_args = (z_row,) if z_row is not None else ()
-        eps_args = (eps,) if eps is not None else ()
+        if sac:
+            eps_args = tuple(eps)  # (eps_next, eps_cur)
+        else:
+            eps_args = (eps,) if eps is not None else ()
         outs = pl.pallas_call(
             kernel,
             grid=(K,),
@@ -743,8 +1004,8 @@ def make_fused_chunk_fn(
         td = outs[0][..., 0]
         met = outs[1][0]
         flat = list(outs[2:])
-        unflat_c = _unflatten_twin if twin else _unflatten
-        nct = nc2 * (2 if twin else 1)
+        unflat_c = _unflatten_twin if (twin or sac) else _unflatten
+        nct = nc2 * (2 if (twin or sac) else 1)
         i = 0
         actor_p = _unflatten(flat[i : i + na2], state.actor_params); i += na2
         critic_p = unflat_c(flat[i : i + nct], state.critic_params); i += nct
@@ -754,6 +1015,16 @@ def make_fused_chunk_fn(
         anu = _unflatten(flat[i : i + na2], state.actor_params); i += na2
         cmu = unflat_c(flat[i : i + nct], state.critic_params); i += nct
         cnu = unflat_c(flat[i : i + nct], state.critic_params); i += nct
+        new_log_alpha, new_alpha_opt = state.log_alpha, state.alpha_opt
+        if sac:
+            new_log_alpha = flat[i].reshape(()); i += 1
+            if autotune:
+                new_alpha_opt = OptState(
+                    mu=flat[i].reshape(()),
+                    nu=flat[i + 1].reshape(()),
+                    count=state.alpha_opt.count + K,
+                )
+                i += 2
 
         if twin and config.policy_delay > 1:
             # Actor count advances only on real updates: multiples of
@@ -773,6 +1044,8 @@ def make_fused_chunk_fn(
             ),
             critic_opt=OptState(mu=cmu, nu=cnu, count=state.critic_opt.count + K),
             step=state.step + K,
+            log_alpha=new_log_alpha,
+            alpha_opt=new_alpha_opt,
         )
         metrics = {k_: met[j] for j, k_ in enumerate(METRIC_KEYS)}
         return new_state, td, metrics

@@ -252,6 +252,33 @@ def sac_actor_loss(
     return jnp.mean(alpha * lp - q), jnp.mean(lp)
 
 
+def sac_target_entropy(target_entropy: float, act_dim: int, action_scale):
+    """Resolve the temperature target as a trace-time Python float (jnp
+    here would yield a tracer under jit): an explicit `target_entropy`
+    wins; nan (the config sentinel) means auto — the 1812.05905 -act_dim
+    heuristic, which is stated for UNIT-box log-probs, shifted by
+    +sum(log scale) because sac_sample's densities live in env action
+    units (without the shift any env with scale > 1 gets a LOWER-entropy
+    target than standard SAC and alpha collapses — measured on Pendulum,
+    scale 2: alpha -> 0.017 and stuck). Shared by learner.sac_step and
+    the fused kernel wrapper so the two paths cannot desync."""
+    import math
+
+    import numpy as np
+
+    if not math.isnan(target_entropy):
+        return float(target_entropy)
+    return -float(act_dim) + float(
+        np.sum(
+            np.log(
+                np.broadcast_to(
+                    np.asarray(action_scale, np.float64), (act_dim,)
+                )
+            )
+        )
+    )
+
+
 # ---------------------------------------------------------------------------
 # Distributional critic (D4PG)
 # ---------------------------------------------------------------------------

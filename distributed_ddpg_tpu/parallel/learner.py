@@ -435,6 +435,7 @@ class ShardedLearner:
         state_spec = mesh_lib.state_pspec(self.state, mesh)
 
         twin_noise = self.config.twin_critic and self.config.target_noise > 0
+        sac = self.config.sac
 
         def local_chunk(s, sub, storage, size):
             axis_idx = jax.lax.axis_index("data")
@@ -451,9 +452,26 @@ class ShardedLearner:
                     self.config, s.step, K, b_local, self.act_dim,
                     device_fold=axis_idx,
                 )
+            elif sac:
+                # Same discipline for SAC's two sampling streams.
+                eps = fused_chunk_lib.sac_noise_eps(
+                    self.config, s.step, K, b_local, self.act_dim,
+                    device_fold=axis_idx,
+                )
             new_s, tds, ms = run_fused(s, storage[idx], eps=eps)
             avg = lambda x: jax.lax.pmean(x, "data")
             favg = lambda tree: jax.tree.map(avg, tree)
+            # SAC temperature state is float — it local-SGDs inside the
+            # chunk and pmeans at the boundary like every other float leaf.
+            extra = {}
+            if new_s.log_alpha is not None:
+                extra["log_alpha"] = avg(new_s.log_alpha)
+            if new_s.alpha_opt is not None:
+                extra["alpha_opt"] = OptState(
+                    mu=avg(new_s.alpha_opt.mu),
+                    nu=avg(new_s.alpha_opt.nu),
+                    count=new_s.alpha_opt.count,
+                )
             new_s = TrainState(
                 actor_params=favg(new_s.actor_params),
                 critic_params=favg(new_s.critic_params),
@@ -470,6 +488,7 @@ class ShardedLearner:
                     count=new_s.critic_opt.count,
                 ),
                 step=new_s.step,
+                **extra,
             )
             return new_s, tds, {k: avg(v) for k, v in ms.items()}
 

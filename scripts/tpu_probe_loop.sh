@@ -2,20 +2,36 @@
 # Bounded TPU-tunnel liveness probe, logged — same incident-record pattern
 # as runs/r3_tpu_outage_probe.log. One line per attempt; exits the moment
 # a probe SUCCEEDS so a recovery is visible as the log's last line.
+#
+# Round-4 upgrade: a probe only counts as RECOVERED if a tiny matmul
+# COMPILES AND EXECUTES. During the 2026-07-31 incident jax.devices()
+# returned normally while any compile/execute hung, so an enumeration-only
+# probe (the round-3 version) would have logged a false recovery. The
+# intermediate state is logged as ENUM_ONLY.
 LOG="${1:-runs/r4_tpu_probe.log}"
 INTERVAL="${2:-300}"
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  out=$(timeout 90 python - <<'EOF' 2>&1
-import jax
+  out=$(timeout 180 python - <<'EOF' 2>&1
+import time, jax, jax.numpy as jnp
 ds = jax.devices()
-print("OK", ds[0].platform, ds[0].device_kind, len(ds))
+print("ENUM", ds[0].platform, ds[0].device_kind, len(ds), flush=True)
+# A failed-to-init TPU runtime can silently fall back to CPU, where the
+# matmul would succeed and fake a recovery — only count a TPU device.
+assert ds[0].platform in ("tpu", "axon"), f"non-TPU fallback: {ds[0]}"
+t = time.time()
+y = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum()
+y.block_until_ready()
+print("OK", ds[0].platform, ds[0].device_kind, float(y),
+      round(time.time() - t, 1))
 EOF
 )
   rc=$?
   if [ $rc -eq 0 ] && echo "$out" | grep -q "^OK"; then
     echo "$ts RECOVERED $(echo "$out" | grep '^OK')" >> "$LOG"
     exit 0
+  elif echo "$out" | grep -q "^ENUM"; then
+    echo "$ts ENUM_ONLY rc=$rc (devices() ok, compute wedged)" >> "$LOG"
   else
     echo "$ts WEDGED rc=$rc" >> "$LOG"
   fi

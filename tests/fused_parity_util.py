@@ -85,6 +85,15 @@ def assert_fused_matches_scan(
     assert int(new_state.actor_opt.count) == int(ref.actor_opt.count)
     assert int(new_state.critic_opt.count) == int(ref.critic_opt.count) == k
     assert int(new_state.step) == k
+    if cfg.sac:
+        # SAC: the in-kernel temperature must track the scan path's.
+        close(new_state.log_alpha, ref.log_alpha)
+        if cfg.sac_autotune:
+            close(new_state.alpha_opt.mu, ref.alpha_opt.mu)
+            close(new_state.alpha_opt.nu, ref.alpha_opt.nu)
+            assert int(new_state.alpha_opt.count) == int(
+                ref.alpha_opt.count
+            ) == k
     np.testing.assert_allclose(
         np.asarray(td), np.stack(ref_tds), rtol=rtol, atol=atol
     )

@@ -132,10 +132,12 @@ def test_fused_per_matches_scan_per():
     the kernel (draw + priority scatter stay XLA ops, IS weights ride the
     packed weight column); same key stream -> identical draws -> the end
     state, TD errors, metrics, AND the updated priority vector must match
-    the scan path at interpret-oracle tolerances. Covers DDPG and D4PG."""
+    the scan path at interpret-oracle tolerances. Covers DDPG, D4PG, and
+    SAC (round-4 kernel envelope)."""
     for extra in (
         {},
         dict(distributional=True, num_atoms=21, v_min=-5.0, v_max=5.0),
+        dict(sac=True),
     ):
         results = {}
         for mode in ("on", "off"):

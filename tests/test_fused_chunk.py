@@ -258,9 +258,11 @@ def test_fused_chunk_on_requires_envelope():
 
 
 def test_supported_gates():
-    # D4PG (C51) and bf16 are INSIDE the envelope since round 4.
+    # D4PG (C51), bf16, and SAC are INSIDE the envelope since round 4.
     assert fused_chunk.supported(DDPGConfig(distributional=True))
     assert fused_chunk.supported(DDPGConfig(compute_dtype="bfloat16"))
+    assert fused_chunk.supported(DDPGConfig(sac=True))
+    assert fused_chunk.supported(DDPGConfig(sac=True, sac_autotune=False))
     assert not fused_chunk.supported(
         DDPGConfig(distributional=True, num_atoms=512)  # unroll cap
     )
@@ -281,3 +283,73 @@ def test_supported_gates():
     # Config typo guard: only auto/on/off are accepted.
     with pytest.raises(ValueError, match="fused_chunk"):
         DDPGConfig(fused_chunk="Off")
+
+
+@pytest.mark.parametrize("autotune", [True, False])
+def test_fused_chunk_sac_matches_scan(autotune):
+    """SAC in the kernel (round 4): Gaussian head split + tanh soft-clamp,
+    reparameterized sampling from the scan path's exact fold_in stream
+    (pre-drawn, streamed like TD3's smoothing noise), entropy-corrected
+    twin TD targets, hand-written backward through the squash log-prob,
+    and the learned temperature's scalar Adam — all vs the autodiff scan
+    path at bit-oracle tolerances. Covers both the learned-alpha and the
+    fixed-alpha configurations."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 24, 16), batch_size=B,
+        sac=True, sac_autotune=autotune, seed=3,
+    )
+    assert fused_chunk.supported(cfg)
+    assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 1.5, 0.25,
+        interpret=True, rtol=2e-4, atol=1e-5, metric_rtol=5e-4,
+    )
+
+
+def test_fused_chunk_sac_bf16_matches_scan():
+    """SAC x mixed precision: bf16 dots with f32 accumulation on both the
+    Gaussian head and the twin critics, bf16-level tolerances."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B,
+        sac=True, compute_dtype="bfloat16", seed=3,
+    )
+    assert fused_chunk.supported(cfg)
+    assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 2.0, 0.0,
+        interpret=True, rtol=3e-2, atol=3e-3, metric_rtol=4e-2,
+    )
+
+
+def test_fused_chunk_sac_step_offset_continuity():
+    """SAC's sampling streams key off the GLOBAL step (fold_in(base,
+    step)), so a second fused chunk starting at step0=K must keep matching
+    the scan path — run two consecutive chunks through the raw kernel fn
+    and the scan, comparing end log_alpha and actor params."""
+    from distributed_ddpg_tpu.learner import init_train_state, make_learner_step
+    from distributed_ddpg_tpu.types import unpack_batch
+    import jax.numpy as jnp
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=B,
+        sac=True, seed=9,
+    )
+    state = init_train_state(cfg, OBS, ACT, seed=9)
+    run = fused_chunk.make_fused_chunk_fn(
+        cfg, OBS, ACT, 1.5, 0.25, chunk_size=3, interpret=True
+    )
+    packed = _batches(np.random.default_rng(13), 6)
+    fused = state
+    for c in range(2):
+        fused, _, _ = jax.jit(run)(fused, jnp.asarray(packed[3 * c : 3 * c + 3]))
+    step = make_learner_step(cfg, 1.5, action_offset=0.25)
+    ref = state
+    for i in range(6):
+        ref = step(ref, unpack_batch(jnp.asarray(packed[i]), OBS, ACT)).state
+    np.testing.assert_allclose(
+        float(fused.log_alpha), float(ref.log_alpha), rtol=2e-4, atol=1e-6
+    )
+    _assert_tree_close(fused.actor_params, ref.actor_params, rtol=5e-4, atol=1e-5)
+    assert int(fused.step) == int(ref.step) == 6

@@ -209,13 +209,15 @@ def test_fused_mesh_bounded_divergence_vs_scan_path():
     [
         dict(distributional=True, num_atoms=21, v_min=-5.0, v_max=5.0),
         dict(twin_critic=True, policy_delay=2, target_noise=0.2),
+        dict(sac=True),
     ],
-    ids=["d4pg", "td3"],
+    ids=["d4pg", "td3", "sac"],
 )
 def test_fused_mesh_runs_all_families(extra):
     """The mesh composition must cover every kernel-envelope family: D4PG
-    (C51 head in-kernel) and TD3 (twin groups + per-device axis-folded
-    smoothing noise — each replica draws iid eps)."""
+    (C51 head in-kernel), TD3 (twin groups + per-device axis-folded
+    smoothing noise — each replica draws iid eps), and SAC (axis-folded
+    sampling streams + the temperature pmean'd at the chunk boundary)."""
     cfg = _cfg(**extra)
     mesh = mesh_lib.make_mesh(data_axis=4, devices=jax.devices()[:4])
     lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, mesh=mesh, chunk_size=3)
@@ -232,6 +234,11 @@ def test_fused_mesh_runs_all_families(extra):
         # Delay 2 over 6 critic steps -> 3 actor updates, replicas agree.
         assert int(jax.device_get(lrn.state.actor_opt.count)) == 3
         assert int(jax.device_get(lrn.state.critic_opt.count)) == 6
+    if "sac" in extra:
+        # The learned temperature moved and stayed a replicated scalar.
+        la = jax.device_get(lrn.state.log_alpha)
+        assert np.isfinite(float(la))
+        assert int(jax.device_get(lrn.state.alpha_opt.count)) == 6
 
 
 def test_fused_mesh_respects_off_and_model_parallel():

@@ -298,8 +298,9 @@ def test_sac_config_gates():
         DDPGConfig(sac=True, sac_log_std_min=3.0)
     from distributed_ddpg_tpu.ops import fused_chunk
 
-    # SAC runs the scan path (no kernel branch yet — docs/OPERATIONS.md).
-    assert not fused_chunk.supported(_cfg())
+    # SAC is inside the megakernel envelope since round 4
+    # (tests/test_fused_chunk.py SAC parity cases).
+    assert fused_chunk.supported(_cfg())
 
 
 def test_sac_sharded_learner_on_mesh():

@@ -93,6 +93,14 @@ def test_fused_kernel_native_parity_td3(tpu):
     assert out["ok"]
 
 
+def test_fused_kernel_native_parity_sac(tpu):
+    """The SAC kernel branch — Gaussian-head lane split, streamed sampling
+    normals, squash log-prob backward, scalar temperature Adam — must
+    compile under real Mosaic and match the scan path."""
+    out = _run_child("fused_parity_sac")
+    assert out["ok"]
+
+
 def test_device_replay_ingest_and_sample_chunk(tpu):
     """Real h2d DeviceReplay ingest + the production run_sample_chunk
     dispatch; fused_chunk='auto' must actually activate on real TPU (if it

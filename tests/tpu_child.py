@@ -174,12 +174,34 @@ def _fused_parity_td3() -> dict:
     return {"ok": True, "critic_loss": float(metrics["critic_loss"])}
 
 
+def _fused_parity_sac() -> dict:
+    """Native Mosaic compile + parity for the SAC kernel branch — the
+    Gaussian-head lane split/concat, streamed sampling normals, squash
+    log-prob backward, and the temperature's scalar Adam on (1,1) refs."""
+    from fused_parity_util import assert_fused_matches_scan
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.ops import fused_chunk
+
+    assert fused_chunk.runs_native(), "needs a native TPU backend"
+    cfg = DDPGConfig(
+        actor_hidden=(256, 256), critic_hidden=(256, 256), batch_size=B,
+        sac=True, seed=3,
+    )
+    metrics = assert_fused_matches_scan(
+        cfg, OBS, ACT, K, 1.0, 0.0,
+        interpret=None, rtol=2e-2, atol=1e-2,
+    )
+    return {"ok": True, "critic_loss": float(metrics["critic_loss"])}
+
+
 CASES = {
     "probe": _probe,
     "fused_parity": _fused_parity,
     "fused_parity_c51": _fused_parity_c51,
     "fused_parity_bf16": _fused_parity_bf16,
     "fused_parity_td3": _fused_parity_td3,
+    "fused_parity_sac": _fused_parity_sac,
     "sample_chunk": _sample_chunk,
 }
 
