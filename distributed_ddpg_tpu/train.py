@@ -49,6 +49,21 @@ def train(config: DDPGConfig) -> Dict[str, float]:
     _enable_faulthandler()
     if config.backend == "native":
         return train_native(config)
+    # Breadcrumb BEFORE the first XLA-backend touch: on this class of host
+    # a wedged accelerator tunnel makes backend init hang with no output
+    # at all (observed live — runs/r4_tpu_probe.log), and the stall
+    # watchdog only arms later. One stderr line turns a silent hang into a
+    # diagnosable one.
+    import jax
+
+    print(
+        f"[train] initializing JAX backend (jax_platforms="
+        f"{jax.config.jax_platforms or 'default'}); a hang here usually "
+        "means the accelerator tunnel is unreachable — set "
+        "JAX_PLATFORMS=cpu to bypass",
+        file=sys.stderr,
+        flush=True,
+    )
     if config.backend == "jax_ondevice":
         return train_ondevice(config)
     return train_jax(config)

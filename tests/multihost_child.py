@@ -16,6 +16,10 @@ Usage: python multihost_child.py <process_id> <num_processes> <port> [mode]
   mode = train:  the FULL train_jax loop (actors + device replay + sharded
                  learner) across the process boundary; parity on the final
                  param checksum (VERDICT.md round-1 Missing #3)
+  mode = fused:  the megakernel x mesh composition (fused_mesh, K-step
+                 local SGD) on a mesh that SPANS processes — the
+                 chunk-boundary param pmean crosses the process boundary
+                 (Gloo here, DCN on a pod); parity on the end state
 """
 
 import os
@@ -55,8 +59,60 @@ def main() -> None:
         run_replay_parity(pid, nprocs, tag=f"proc{pid}")
     elif mode == "train":
         run_train_parity(tag=f"proc{pid}")
+    elif mode == "fused":
+        run_fused_mesh_parity(tag=f"proc{pid}")
     else:
         raise SystemExit(f"unknown mode {mode!r}")
+
+
+def run_fused_mesh_parity(tag: str) -> None:
+    """Megakernel x mesh across the process boundary: every one of the 4
+    global devices (2 per process) runs the whole K-step chunk in one
+    pallas launch (interpret mode on CPU) on its own draws, then the
+    chunk-boundary float-state pmean rides the cross-process collective.
+    Identical replicated storage on both processes -> the per-device draws
+    are a pure function of the replicated key stream -> both processes
+    must print identical losses and end-state checksums; a fork means the
+    boundary AllReduce or the axis-folded draw streams diverged."""
+    import numpy as np
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+
+    obs_dim, act_dim = 5, 2
+    config = DDPGConfig(
+        actor_hidden=(16, 16), critic_hidden=(16, 16), batch_size=8,
+        seed=0, fused_chunk="on",
+    )
+    learner = ShardedLearner(
+        config, obs_dim, act_dim, action_scale=1.0, chunk_size=2
+    )
+    assert learner.fused_mesh_active, (
+        "fused_mesh must activate on the cross-process data mesh: "
+        f"{learner.fused_chunk_error}"
+    )
+    replay = DeviceReplay(
+        256, obs_dim, act_dim, mesh=learner.mesh, block_size=64
+    )
+    rng = np.random.default_rng(7)
+    width = replay.width
+    # Multi-process add_packed only buffers host-side; rows land via the
+    # lockstep sync_ship (same discipline as run_replay_parity — without
+    # it the storage stays empty and the parity check is vacuous).
+    replay.add_packed(rng.standard_normal((128, width)).astype(np.float32))
+    moved = replay.sync_ship()
+    moved += replay.sync_ship(force=True)
+    assert moved > 0 and len(replay) > 0, (moved, len(replay))
+    out = learner.run_sample_chunk(replay)
+    import jax
+
+    loss = float(jax.device_get(out.metrics["critic_loss"]))
+    out2 = learner.run_sample_chunk(replay)
+    loss2 = float(jax.device_get(out2.metrics["critic_loss"]))
+    leaves = jax.tree.leaves(jax.device_get(learner.state.actor_params))
+    checksum = float(sum(np.abs(leaf).sum() for leaf in leaves))
+    print(f"PARITY {tag} {loss:.8f}/{loss2:.8f} {checksum:.6f}", flush=True)
 
 
 def run_replay_parity(pid: int, nprocs: int, tag: str) -> None:

@@ -109,6 +109,20 @@ def test_two_process_device_replay_ingest():
 
 
 @pytest.mark.slow
+def test_two_process_fused_mesh_parity():
+    """Megakernel x mesh (fused_mesh, K-step local SGD) on a {data:4} mesh
+    spanning 2 processes: the chunk-boundary param pmean is a CROSS-PROCESS
+    collective (Gloo here, DCN on a pod). Both processes must report
+    identical chunk losses and end-state checksums — the multi-host
+    analogue of the single-process fused-mesh parity suite, closing the
+    gap between 'fused_mesh works on one host' and the BASELINE.json:11
+    multi-host topology."""
+    (_, losses0, ck0), (_, losses1, ck1) = _run_pair("fused")
+    assert losses0 == losses1, f"fused chunk loss fork: {losses0} vs {losses1}"
+    assert ck0 == ck1, f"param checksum fork: {ck0} vs {ck1}"
+
+
+@pytest.mark.slow
 def test_two_process_full_train_jax():
     """The FULL train_jax loop (actor pool -> lockstep device-replay ingest
     -> fused-sampling sharded learner -> globally-summed env-step budget)
