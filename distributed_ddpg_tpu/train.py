@@ -56,11 +56,17 @@ def train(config: DDPGConfig) -> Dict[str, float]:
     # diagnosable one.
     import jax
 
+    plat = jax.config.jax_platforms or "default"
+    hint = (
+        ""
+        if plat == "cpu"
+        else (
+            "; a hang here usually means the accelerator tunnel is "
+            "unreachable — set JAX_PLATFORMS=cpu to bypass"
+        )
+    )
     print(
-        f"[train] initializing JAX backend (jax_platforms="
-        f"{jax.config.jax_platforms or 'default'}); a hang here usually "
-        "means the accelerator tunnel is unreachable — set "
-        "JAX_PLATFORMS=cpu to bypass",
+        f"[train] initializing JAX backend (jax_platforms={plat}){hint}",
         file=sys.stderr,
         flush=True,
     )

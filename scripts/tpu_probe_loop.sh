@@ -10,6 +10,9 @@
 # intermediate state is logged as ENUM_ONLY.
 LOG="${1:-runs/r4_tpu_probe.log}"
 INTERVAL="${2:-300}"
+# RUN_ON_RECOVERY=1: chain straight into the unattended TPU evidence
+# queue (scripts/tpu_recovery_runbook.sh) the moment compute returns.
+RUN_ON_RECOVERY="${RUN_ON_RECOVERY:-0}"
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   out=$(timeout 180 python - <<'EOF' 2>&1
@@ -29,6 +32,15 @@ EOF
   rc=$?
   if [ $rc -eq 0 ] && echo "$out" | grep -q "^OK"; then
     echo "$ts RECOVERED $(echo "$out" | grep '^OK')" >> "$LOG"
+    if [ "$RUN_ON_RECOVERY" = "1" ]; then
+      RUNBOOK="$(dirname "$0")/tpu_recovery_runbook.sh"
+      if [ -f "$RUNBOOK" ]; then
+        echo "$ts launching recovery runbook" >> "$LOG"
+        bash "$RUNBOOK" >> "$LOG" 2>&1
+      else
+        echo "$ts RUNBOOK_MISSING $RUNBOOK — evidence queue NOT run" >> "$LOG"
+      fi
+    fi
     exit 0
   elif echo "$out" | grep -q "^ENUM"; then
     echo "$ts ENUM_ONLY rc=$rc (devices() ok, compute wedged)" >> "$LOG"
