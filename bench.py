@@ -479,37 +479,51 @@ def main() -> int:
     }
     errors = []
 
-    native, err = _run_phase("native", {"JAX_PLATFORMS": "cpu"}, timeout=600)
-    if native:
-        result["baseline_native_cpu"] = round(native["native_rate"], 1)
-    else:
-        errors.append(err)
+    def note(msg):
+        # Progress to stderr so an outer timeout that kills us mid-run
+        # still leaves a trail of which phase we were in (the 2026-07-31
+        # flapping-tunnel incident produced a 900s empty log).
+        print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+              file=sys.stderr, flush=True)
 
-    # Accelerator phase: honor an explicit platform override; otherwise let
-    # the default (TPU/axon) platform resolve inside the subprocess. Retry
-    # with backoff — the round-1 failure was a transiently Unavailable
-    # remote backend.
+    # Accelerator FIRST. The axon tunnel flaps: recovery windows as short
+    # as ~3 minutes were observed (runs/r4_tpu_probe.log, 2026-07-31), so
+    # the TPU capture must happen the moment the harness starts, while the
+    # window is hot — the CPU-native baseline can't wedge and runs after.
+    # Honor an explicit platform override; otherwise let the default
+    # (TPU/axon) platform resolve inside the subprocess. Retry with
+    # backoff — the round-1 failure was a transiently Unavailable backend.
     accel_env = {}
     forced = os.environ.get("JAX_PLATFORMS") or os.environ.get("BENCH_PLATFORM")
     if forced:
         accel_env["JAX_PLATFORMS"] = forced
-    # Probe the backend cheaply (bounded 180s) before committing to the
-    # expensive bench run; a wedged remote TPU runtime then costs 3 short
-    # probes, not 3 full bench timeouts.
+    # Probe the backend cheaply before committing to the expensive bench
+    # run; a wedged remote TPU runtime then costs 3 short probes, not 3
+    # full bench timeouts. 90s covers a cold connect+compile (~30-40s
+    # observed) with margin; a wedged tunnel hangs far past it.
     accel = None
     probe = None
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    # Accelerator-path errors are tracked separately from the shared
+    # errors list so result["tpu_error"] can never pick up a later
+    # CPU-native phase failure (the native phase now runs in between).
+    accel_errors = []
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
     for attempt in range(3):
+        note(f"probe attempt {attempt + 1} (timeout {probe_timeout:.0f}s)")
         probe, err = _run_phase("probe", accel_env, timeout=probe_timeout)
         if probe and probe.get("ok"):
+            note(f"probe ok: {probe.get('platform')} {probe.get('device_kind')}")
             break
         probe = None
-        errors.append(f"probe attempt {attempt + 1}: {err}")
-        time.sleep(10 * (attempt + 1))
+        accel_errors.append(f"probe attempt {attempt + 1}: {err}")
+        note(f"probe failed: {str(err)[:200]}")
+        if attempt < 2:
+            time.sleep(5 * (attempt + 1))
     if probe:
+        note("accelerator measurement phase")
         accel, err = _run_phase("jax", accel_env, timeout=900)
         if not accel:
-            errors.append(err)
+            accel_errors.append(err)
             # Second line of defense: the phase-internal retry handles
             # kernel failures, but if the whole phase died (e.g. a crash
             # that took the subprocess down), try once more with the
@@ -524,18 +538,39 @@ def main() -> int:
                     "jax", {**accel_env, "BENCH_FUSED": "off"}, timeout=900
                 )
                 if not accel:
-                    errors.append(err)
+                    accel_errors.append(err)
+    # CPU-native baseline — the vs_baseline denominator. Tunnel-independent
+    # (JAX_PLATFORMS=cpu), so it runs AFTER the time-critical accelerator
+    # capture and cannot wedge it.
+    note("native baseline phase")
+    native, err = _run_phase("native", {"JAX_PLATFORMS": "cpu"}, timeout=600)
+    if native:
+        result["baseline_native_cpu"] = round(native["native_rate"], 1)
+        note(f"native baseline: {native['native_rate']:.1f}/s")
+    else:
+        errors.append(err)
+
     if accel is None and forced != "cpu":
-        # Accelerator dead: fall back to JAX-on-CPU so the harness still
-        # reports an end-to-end jax-path number, clearly labeled. (forced
-        # may be a site default like JAX_PLATFORMS=axon — that must not
-        # suppress the fallback; only an explicit cpu run makes it moot.)
-        result["tpu_error"] = "; ".join(errors[-3:])
-        accel, err = _run_phase(
-            "jax", {"JAX_PLATFORMS": "cpu", "BENCH_SECONDS": "5"}, timeout=900
-        )
-        if err:
-            errors.append(err)
+        result["tpu_error"] = "; ".join(accel_errors[-3:])
+        if os.environ.get("BENCH_REQUIRE_TPU", "0") == "1":
+            # Runbook mode: the caller only wants the TPU capture (it
+            # gates its completion marker on platform:"tpu") — a CPU
+            # fallback number would cost ~15 min of a recovery window
+            # and be thrown away. Emit the partial result and stop.
+            note("accelerator dead and BENCH_REQUIRE_TPU=1: no fallback")
+        else:
+            # Accelerator dead: fall back to JAX-on-CPU so the harness
+            # still reports an end-to-end jax-path number, clearly
+            # labeled. (forced may be a site default like
+            # JAX_PLATFORMS=axon — that must not suppress the fallback;
+            # only an explicit cpu run makes it moot.)
+            note("accelerator dead: JAX-on-CPU fallback")
+            accel, err = _run_phase(
+                "jax", {"JAX_PLATFORMS": "cpu", "BENCH_SECONDS": "5"},
+                timeout=900,
+            )
+            if err:
+                errors.append(err)
 
     if accel:
         result["value"] = round(accel["rate"], 1)
@@ -560,6 +595,7 @@ def main() -> int:
         and accel
         and "tpu_error" not in result
     ):
+        note("kernel study phase")
         study, err = _run_phase("study", accel_env, timeout=1800)
         if study:
             result.update(study)
@@ -567,6 +603,7 @@ def main() -> int:
             errors.append(err)
 
     if os.environ.get("BENCH_SCALING", "1") != "0":
+        note("virtual-device scaling phase")
         scaling, err = _run_phase(
             "scaling",
             {
@@ -581,8 +618,8 @@ def main() -> int:
         else:
             errors.append(err)
 
-    if errors and "tpu_error" not in result:
-        result["errors"] = errors[-3:]
+    if (errors or accel_errors) and "tpu_error" not in result:
+        result["errors"] = (accel_errors + errors)[-3:]
     print(json.dumps(result), flush=True)
     return 0 if native else 1
 

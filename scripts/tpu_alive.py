@@ -1,0 +1,26 @@
+"""Shared compute-verified TPU liveness probe.
+
+THE definition of "tunnel alive", used by both scripts/tpu_probe_loop.sh
+and scripts/tpu_recovery_runbook.sh so the two can't drift (the
+2026-07-31 incident needed the same lesson — jax.devices() can succeed
+while all compute wedges — encoded in every caller).
+
+Prints "ENUM <platform> <kind> <n>" once devices enumerate, then
+"OK <platform> <kind> <sum> <seconds>" once a small matmul round-trips.
+Exit 0 only on OK. Callers bound wall-clock with `timeout`.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+ds = jax.devices()
+print("ENUM", ds[0].platform, ds[0].device_kind, len(ds), flush=True)
+# A failed-to-init TPU runtime can silently fall back to CPU, where the
+# matmul would succeed and fake a recovery — only count a TPU device.
+assert ds[0].platform in ("tpu", "axon"), f"non-TPU fallback: {ds[0]}"
+t = time.time()
+y = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum()
+y.block_until_ready()
+print("OK", ds[0].platform, ds[0].device_kind, float(y),
+      round(time.time() - t, 1), flush=True)

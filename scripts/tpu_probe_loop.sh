@@ -1,34 +1,24 @@
 #!/bin/bash
 # Bounded TPU-tunnel liveness probe, logged — same incident-record pattern
-# as runs/r3_tpu_outage_probe.log. One line per attempt; exits the moment
-# a probe SUCCEEDS so a recovery is visible as the log's last line.
+# as runs/r3_tpu_outage_probe.log. One line per attempt.
 #
-# Round-4 upgrade: a probe only counts as RECOVERED if a tiny matmul
-# COMPILES AND EXECUTES. During the 2026-07-31 incident jax.devices()
-# returned normally while any compile/execute hung, so an enumeration-only
-# probe (the round-3 version) would have logged a false recovery. The
-# intermediate state is logged as ENUM_ONLY.
+# Round-4 upgrades:
+#   - a probe only counts as RECOVERED if a tiny matmul COMPILES AND
+#     EXECUTES: during the 2026-07-31 incident jax.devices() returned
+#     normally while any compile/execute hung (logged as ENUM_ONLY);
+#   - the tunnel FLAPS (one observed window lasted ~3 min), so with
+#     RUN_ON_RECOVERY=1 the loop chains into the RESUMABLE evidence
+#     queue (scripts/tpu_recovery_runbook.sh) on EVERY recovery and only
+#     exits once the runbook reports the whole queue drained (rc=0);
+#   - probe timeout 90s / interval 60s so a short window can't slip
+#     between probes (a wedged probe hangs the full 90s, so the
+#     effective cadence while wedged is ~2.5 min).
 LOG="${1:-runs/r4_tpu_probe.log}"
-INTERVAL="${2:-300}"
-# RUN_ON_RECOVERY=1: chain straight into the unattended TPU evidence
-# queue (scripts/tpu_recovery_runbook.sh) the moment compute returns.
+INTERVAL="${2:-60}"
 RUN_ON_RECOVERY="${RUN_ON_RECOVERY:-0}"
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  out=$(timeout 180 python - <<'EOF' 2>&1
-import time, jax, jax.numpy as jnp
-ds = jax.devices()
-print("ENUM", ds[0].platform, ds[0].device_kind, len(ds), flush=True)
-# A failed-to-init TPU runtime can silently fall back to CPU, where the
-# matmul would succeed and fake a recovery — only count a TPU device.
-assert ds[0].platform in ("tpu", "axon"), f"non-TPU fallback: {ds[0]}"
-t = time.time()
-y = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum()
-y.block_until_ready()
-print("OK", ds[0].platform, ds[0].device_kind, float(y),
-      round(time.time() - t, 1))
-EOF
-)
+  out=$(timeout 90 python "$(dirname "$0")/tpu_alive.py" 2>&1)
   rc=$?
   if [ $rc -eq 0 ] && echo "$out" | grep -q "^OK"; then
     echo "$ts RECOVERED $(echo "$out" | grep '^OK')" >> "$LOG"
@@ -36,12 +26,18 @@ EOF
       RUNBOOK="$(dirname "$0")/tpu_recovery_runbook.sh"
       if [ -f "$RUNBOOK" ]; then
         echo "$ts launching recovery runbook" >> "$LOG"
-        bash "$RUNBOOK" >> "$LOG" 2>&1
+        if bash "$RUNBOOK" >> "$LOG" 2>&1; then
+          echo "$ts queue fully drained — probe loop exiting" >> "$LOG"
+          exit 0
+        fi
+        echo "$ts runbook returned with queue incomplete; rewatching" >> "$LOG"
       else
         echo "$ts RUNBOOK_MISSING $RUNBOOK — evidence queue NOT run" >> "$LOG"
+        exit 0
       fi
+    else
+      exit 0
     fi
-    exit 0
   elif echo "$out" | grep -q "^ENUM"; then
     echo "$ts ENUM_ONLY rc=$rc (devices() ok, compute wedged)" >> "$LOG"
   else
