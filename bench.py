@@ -508,12 +508,30 @@ def main() -> int:
     # CPU-native phase failure (the native phase now runs in between).
     accel_errors = []
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    require_tpu = os.environ.get("BENCH_REQUIRE_TPU", "0") == "1"
     for attempt in range(3):
         note(f"probe attempt {attempt + 1} (timeout {probe_timeout:.0f}s)")
         probe, err = _run_phase("probe", accel_env, timeout=probe_timeout)
         if probe and probe.get("ok"):
-            note(f"probe ok: {probe.get('platform')} {probe.get('device_kind')}")
-            break
+            if require_tpu and probe.get("platform") not in ("tpu", "axon"):
+                # With JAX_PLATFORMS unset, a failed TPU plugin init falls
+                # back to CPU SILENTLY — the probe would "pass" with
+                # platform cpu and the 900s jax phase would burn a recovery
+                # window on a doomed CPU measurement (the exact failure
+                # scripts/tpu_alive.py asserts against). Under
+                # BENCH_REQUIRE_TPU=1 that is a probe FAILURE.
+                err = (
+                    f"probe platform {probe.get('platform')!r} is not an "
+                    "accelerator (silent CPU fallback) under "
+                    "BENCH_REQUIRE_TPU=1"
+                )
+                probe = None
+            else:
+                note(
+                    f"probe ok: {probe.get('platform')} "
+                    f"{probe.get('device_kind')}"
+                )
+                break
         probe = None
         accel_errors.append(f"probe attempt {attempt + 1}: {err}")
         note(f"probe failed: {str(err)[:200]}")
@@ -552,7 +570,7 @@ def main() -> int:
 
     if accel is None and forced != "cpu":
         result["tpu_error"] = "; ".join(accel_errors[-3:])
-        if os.environ.get("BENCH_REQUIRE_TPU", "0") == "1":
+        if require_tpu:
             # Runbook mode: the caller only wants the TPU capture (it
             # gates its completion marker on platform:"tpu") — a CPU
             # fallback number would cost ~15 min of a recovery window

@@ -11,7 +11,7 @@
 #   - bench stages run with BENCH_REQUIRE_TPU=1 so a mid-window wedge
 #     emits its partial JSON quickly instead of burning the next window
 #     in a doomed CPU fallback;
-#   - failures are only counted toward the 2-strike gave_up if the
+#   - failures are only counted toward the 3-strike gave_up if the
 #     tunnel is STILL ALIVE right after the failure — a fast Unavailable
 #     exception from a tunnel drop (rc=1, the round-1 failure mode) must
 #     not permanently retire a stage that never ran on a healthy tunnel.
@@ -35,9 +35,7 @@
 #   sweep    (~30 min) staleness sweep, all four EVIDENCE §4 rows.
 #   ladder23 (~20 min) rungs 2,3 TPU re-records with platform field.
 #
-# Outer stage timeouts cover bench.py's internal worst case under
-# BENCH_REQUIRE_TPU=1 (probe 90s + jax 900s + fused-off retry 900s +
-# native 600s ≈ 2490s → 2700; study adds its 1800s grant → 4500).
+# Outer stage timeouts: derivation lives next to the stage list below.
 set -u
 cd "$(dirname "$0")/.."
 DONE_DIR="runs/r4_queue_done"
@@ -58,7 +56,12 @@ alive() {
 count_failure() {  # count_failure <name> <rc>
   # A hang (rc=124) or a failure with the tunnel dead right afterwards is
   # wedge-collateral: no strike, the stage retries in the next window.
-  local name=$1 rc=$2
+  # 3-strike budget for ALL stages: a window closing mid-run (partial
+  # output, rc!=124) and reopening before the alive() check below records
+  # a wedge-collateral failure as a "real" strike — the flapping tunnel
+  # races this attribution for any long stage (tputests/ladder23 run
+  # 15-20 min), so every stage needs slack before a permanent give-up.
+  local name=$1 rc=$2 limit=3
   if [ "$rc" -eq 124 ]; then
     note "FAIL $name rc=124 (hang — no strike)"
     return
@@ -68,15 +71,33 @@ count_failure() {  # count_failure <name> <rc>
     return
   fi
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) rc=$rc" >> "$DONE_DIR/$name.fail"
-  note "FAIL $name rc=$rc (strike $(wc -l < "$DONE_DIR/$name.fail")/2)"
-  if [ "$(wc -l < "$DONE_DIR/$name.fail")" -ge 2 ]; then
-    note "GIVE-UP $name (2 real failures on a live tunnel)"
+  note "FAIL $name rc=$rc (strike $(wc -l < "$DONE_DIR/$name.fail")/$limit)"
+  if [ "$(wc -l < "$DONE_DIR/$name.fail")" -ge "$limit" ]; then
+    note "GIVE-UP $name ($limit real failures on a live tunnel)"
     mv "$DONE_DIR/$name.fail" "$DONE_DIR/$name.gave_up"
   fi
 }
 
-stage() {  # stage <name> <timeout_s> <evidence_grep|-> <cmd...>
+check_evidence() {  # check_evidence <log> <wantspec>
+  # wantspec is '-' (no gate) or one-or-more grep patterns joined by the
+  # literal separator '%%' — ALL must match (e.g. the study stage needs
+  # both '"study"' AND the platform:"tpu" pattern; grepping '"study"'
+  # alone would let a silent CPU fallback retire the stage with CPU
+  # numbers).
+  local log=$1 spec=$2 pat rest
+  [ "$spec" = "-" ] && return 0
+  rest=$spec
+  while [ -n "$rest" ]; do
+    pat=${rest%%'%%'*}
+    if [ "$pat" = "$rest" ]; then rest=""; else rest=${rest#*%%}; fi
+    grep -q "$pat" "$log" || return 1
+  done
+  return 0
+}
+
+stage() {  # stage <name> <timeout_s> <evidence_spec|-> <cmd...>
   local name=$1 tmo=$2 want=$3; shift 3
+  local gated=0; [ "$want" != "-" ] && gated=1
   if [ -f "$DONE_DIR/$name.done" ] || [ -f "$DONE_DIR/$name.gave_up" ]; then
     note "DONE-SKIP $name"
     return 0
@@ -88,7 +109,7 @@ stage() {  # stage <name> <timeout_s> <evidence_grep|-> <cmd...>
   note "START $name"
   local log="runs/r4_recovery_${STAMP}_${name}.log"
   if timeout "$tmo" "$@" > "$log" 2>&1; then
-    if [ "$want" != "-" ] && ! grep -q "$want" "$log"; then
+    if ! check_evidence "$log" "$want"; then
       note "NO-EVIDENCE $name (rc=0 but '$want' absent — not retired)"
       count_failure "$name" 0
       return 1
@@ -96,18 +117,34 @@ stage() {  # stage <name> <timeout_s> <evidence_grep|-> <cmd...>
     note "OK $name"
     date -u +%Y-%m-%dT%H:%M:%SZ > "$DONE_DIR/$name.done"
   else
-    count_failure "$name" $?
+    local rc=$?
+    # bench.py exits nonzero when e.g. the native-baseline phase fails
+    # even if the TPU capture itself succeeded and its platform:"tpu"
+    # JSON is sitting in the log — valid evidence retires the stage
+    # regardless of exit code.
+    if [ "$gated" = "1" ] && check_evidence "$log" "$want"; then
+      note "OK $name (rc=$rc but required evidence captured — retired)"
+      date -u +%Y-%m-%dT%H:%M:%SZ > "$DONE_DIR/$name.done"
+      return 0
+    fi
+    count_failure "$name" "$rc"
   fi
 }
 
 TPU='"platform": "\(tpu\|axon\)"'
 note "recovery runbook start (markers: $(ls "$DONE_DIR" 2>/dev/null | tr '\n' ' '))"
-stage bench    2700 "$TPU" env BENCH_SECONDS=5 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
+# Outer timeouts strictly dominate bench.py's internal worst case under
+# BENCH_REQUIRE_TPU=1 with BENCH_PROBE_TIMEOUT pinned to 90 below
+# (3x90s probes + 15s sleeps + 900s jax + 900s fused-off retry + 600s
+# native = 2685s before interpreter/phase overhead): 3000 for
+# bench/chunk, 4800 for study (its extra grid grant), so a legitimately
+# progressing run is never killed at rc=124 with a silently burnt window.
+stage bench    3000 "$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_SECONDS=5 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
 stage smoke    300  -      python tests/tpu_child.py fused_parity
 stage tputests 1500 -      python -m pytest tests/test_tpu.py -q
-stage study    4500 '"study"' env BENCH_STUDY=1 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
-stage chunk16  2700 "$TPU" env BENCH_CHUNK=1600 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
-stage chunk32  2700 "$TPU" env BENCH_CHUNK=3200 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
+stage study    4800 '"study"'"%%$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_STUDY=1 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
+stage chunk16  3000 "$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_CHUNK=1600 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
+stage chunk32  3000 "$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_CHUNK=3200 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
 stage sweep    2700 -      bash scripts/staleness_sweep.sh
 stage ladder23 2400 -      python -m distributed_ddpg_tpu.ladder --rungs=2,3 --log_dir=runs
 note "recovery runbook done (markers: $(ls "$DONE_DIR" 2>/dev/null | tr '\n' ' '))"
