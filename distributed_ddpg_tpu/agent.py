@@ -25,6 +25,7 @@ from distributed_ddpg_tpu.learner import (
     make_act_fn,
     make_sample_fn,
 )
+from distributed_ddpg_tpu.ops import support_auto
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import NStepAccumulator, make_replay
 from distributed_ddpg_tpu.types import Batch, batch_from_numpy
@@ -62,6 +63,18 @@ class DDPGAgent:
         )
         self.nstep = NStepAccumulator(config.n_step, config.gamma)
         self._learn_steps = 0
+        # Auto C51 support (resolved lazily at the first train_step; the
+        # flag must outlive the resolution — after it self.config carries
+        # concrete bounds and v_support_auto reads False).
+        self._support_auto_active = config.distributional and config.v_support_auto
+        self._support_controller = support_auto.SupportController()
+
+    def _set_value_bounds(self, v_min: float, v_max: float) -> None:
+        self.config = self.config.replace(v_min=float(v_min), v_max=float(v_max))
+        self._step_fn = jit_learner_step(
+            self.config, self.spec.action_scale,
+            action_offset=self.spec.action_offset,
+        )
 
     # --- acting (SURVEY.md §3.2) ---
 
@@ -102,12 +115,31 @@ class DDPGAgent:
     def train_step(self) -> Optional[Dict[str, float]]:
         if not self.can_train():
             return None
+        if self.config.distributional and self.config.v_support_auto:
+            # Auto C51 support (ops/support_auto.py): the replay just crossed
+            # the warmup threshold, so size the bounds from its reward
+            # statistics and rebuild the (lazily jitted) step — no compile
+            # has happened yet, so this costs nothing extra. After this the
+            # config carries concrete bounds and the branch never re-enters.
+            # Running expansion: the SupportController check further down.
+            v_lo, v_hi = support_auto.initial_bounds(
+                self.replay.reward_sample(), self.config.gamma,
+                self.config.n_step,
+            )
+            self._set_value_bounds(v_lo, v_hi)
         sample = self.replay.sample(self.config.batch_size)
         indices = sample.pop("indices")
         batch = batch_from_numpy(sample)
         out: StepOutput = self._step_fn(self.state, batch)
         self.state = out.state
         self._learn_steps += 1
+        if self._support_auto_active and self._learn_steps % 50 == 0:
+            grown = self._support_controller.check(
+                self.config.v_min, self.config.v_max,
+                float(out.metrics["mean_q"]), self._learn_steps,
+            )
+            if grown is not None:
+                self._set_value_bounds(*grown)
         if self.config.prioritized:
             # The only extra device->host transfer PER costs (uniform replay
             # skips it entirely — update_priorities would be a no-op).

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 from typing import Any, Dict, Optional, Tuple
 
@@ -51,7 +52,8 @@ COMPAT_FIELDS = (
 
 
 def _snapshot(
-    step: int, state: TrainState, replay, env_steps: int
+    step: int, state: TrainState, replay, env_steps: int,
+    v_bounds=None,
 ) -> Dict[str, Any]:
     """Materialize everything host-side. This is the only part that touches
     device memory; once it returns, the learner is free to mutate/donate
@@ -60,6 +62,13 @@ def _snapshot(
         "state": jax.device_get(state),
         "meta": {"env_steps": np.asarray(env_steps, np.int64)},
     }
+    if v_bounds is not None:
+        # Auto-sized C51 support (config.v_support_auto): the RESOLVED
+        # bounds must ride the checkpoint — mean_q-driven expansions are
+        # unrecoverable from reward statistics, and restoring the critic's
+        # logits over re-derived (smaller) atom values would silently
+        # reinterpret every probability as a wrong Q.
+        ckpt["meta"]["v_bounds"] = np.asarray(v_bounds, np.float64)
     if replay is not None:
         ckpt["replay"] = replay.state_dict()
     return ckpt
@@ -71,8 +80,15 @@ def _write(directory: str, step: int, ckpt: Dict[str, Any],
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, ckpt)
     if config is not None:
+        # nan (the v_min/v_max auto sentinel) would serialize as the
+        # non-RFC bare `NaN` token — unreadable by jq and strict parsers.
+        # null keeps the file valid JSON; _compat_eq maps it back.
+        fields = {
+            k: (None if isinstance(v, float) and math.isnan(v) else v)
+            for k, v in dataclasses.asdict(config).items()
+        }
         with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
-            json.dump(dataclasses.asdict(config), f, indent=2, default=list)
+            json.dump(fields, f, indent=2, default=list)
     return path
 
 
@@ -83,9 +99,14 @@ def save(
     replay=None,
     config: Optional[DDPGConfig] = None,
     env_steps: int = 0,
+    v_bounds=None,
 ) -> str:
     """Write checkpoint `directory/step_N` synchronously. Returns the path."""
-    return _write(directory, step, _snapshot(step, state, replay, env_steps), config)
+    return _write(
+        directory, step,
+        _snapshot(step, state, replay, env_steps, v_bounds=v_bounds),
+        config,
+    )
 
 
 class AsyncSaver:
@@ -118,6 +139,7 @@ class AsyncSaver:
         replay=None,
         config: Optional[DDPGConfig] = None,
         env_steps: int = 0,
+        v_bounds=None,
     ) -> bool:
         """Snapshot now, write in the background. Returns False (and skips)
         if the previous write is still in flight."""
@@ -127,7 +149,7 @@ class AsyncSaver:
             if self.busy:
                 self.skipped += 1
                 return False
-            ckpt = _snapshot(step, state, replay, env_steps)
+            ckpt = _snapshot(step, state, replay, env_steps, v_bounds=v_bounds)
 
             def _run():
                 try:
@@ -164,7 +186,7 @@ def check_config_compatible(directory: str, step: int, config: DDPGConfig) -> No
     mismatches = [
         f"{k}: checkpoint={saved[k]!r} run={_listify(current[k])!r}"
         for k in COMPAT_FIELDS
-        if k in saved and saved[k] != _listify(current[k])
+        if k in saved and not _compat_eq(saved[k], _listify(current[k]))
     ]
     if mismatches:
         raise ValueError(
@@ -176,6 +198,19 @@ def check_config_compatible(directory: str, step: int, config: DDPGConfig) -> No
 
 def _listify(v):
     return list(v) if isinstance(v, tuple) else v
+
+
+def _compat_eq(a, b) -> bool:
+    # nan == nan for compat purposes: v_min/v_max use nan as the 'auto'
+    # sentinel (config.py), and two auto runs ARE compatible — IEEE
+    # inequality would reject every auto-support resume. The saved side
+    # serializes the sentinel as null (_write), so None matches nan too.
+    def _is_auto(v) -> bool:
+        return v is None or (isinstance(v, float) and math.isnan(v))
+
+    if _is_auto(a) and _is_auto(b):
+        return True
+    return a == b
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -195,11 +230,15 @@ def restore(
     replay=None,
     step: Optional[int] = None,
     config: Optional[DDPGConfig] = None,
+    meta_out: Optional[Dict[str, Any]] = None,
 ) -> Tuple[TrainState, int, int]:
     """Restore (TrainState, step, env_steps). If `replay` is given its
     contents are restored in place. `state_template` supplies the tree
     structure/shapes (orbax restores into abstract targets). When `config`
-    is given, the checkpoint's saved config is validated against it first."""
+    is given, the checkpoint's saved config is validated against it first.
+    `meta_out`, when given, is filled with the checkpoint's extra metadata
+    (currently: "v_bounds" — the resolved auto-support bounds, present only
+    on checkpoints from auto-support runs)."""
     if step is None:
         step = latest_step(directory)
         if step is None:
@@ -218,16 +257,36 @@ def restore(
         # subtree, and orbax requires the template to match the on-disk tree
         # exactly. Probe the saved structure rather than catching ValueError,
         # so genuine template mismatches keep their original diagnostic.
+        has_bounds = False
         try:
             on_disk = ckptr.metadata(path)
-            has_meta = "meta" in getattr(on_disk, "tree", on_disk)
+            # The saved tree's location varies by orbax version: current
+            # StandardCheckpointer returns StepMetadata with the tree under
+            # .item_metadata.tree; older versions exposed .tree or the raw
+            # tree itself.
+            tree = getattr(on_disk, "tree", None)
+            if tree is None:
+                tree = getattr(
+                    getattr(on_disk, "item_metadata", None), "tree", None
+                )
+            if tree is None:
+                tree = on_disk
+            has_meta = "meta" in tree
+            has_bounds = has_meta and "v_bounds" in tree["meta"]
         except Exception:
             has_meta = True  # metadata unreadable: let restore() report it
         if not has_meta:
             template.pop("meta")  # env_steps then resumes as 0
+        elif has_bounds:
+            template["meta"]["v_bounds"] = np.zeros(2, np.float64)
         restored = ckptr.restore(path, template)
     if replay is not None:
         replay.load_state_dict(restored["replay"])
     state = jax.tree.map(np.asarray, restored["state"])
-    env_steps = int(restored.get("meta", {}).get("env_steps", 0))
+    meta = restored.get("meta", {})
+    env_steps = int(meta.get("env_steps", 0))
+    if meta_out is not None:
+        if "v_bounds" in meta:
+            vb = np.asarray(meta["v_bounds"], np.float64)
+            meta_out["v_bounds"] = (float(vb[0]), float(vb[1]))
     return state, step, env_steps

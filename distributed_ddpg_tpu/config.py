@@ -9,6 +9,7 @@ lr_actor=1e-4, lr_critic=1e-3, batch=64, buffer ~1e6, OU theta=0.15 sigma=0.2.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Sequence
 
 
@@ -38,6 +39,13 @@ class DDPGConfig:
     # --- distributional critic (D4PG) ---
     distributional: bool = False
     num_atoms: int = 51
+    # Value-support bounds. nan = AUTO (CLI: --v_min=auto --v_max=auto, both
+    # together): sized from warmup reward statistics at learner start, then
+    # expanded geometrically whenever mean_q approaches an edge
+    # (ops/support_auto.py — kills the per-env hand knob that needed ±400
+    # for LunarLander and [-1600, 0] for Pendulum, docs/EVIDENCE.md §3).
+    # nan, not 0/inf, is the sentinel — same convention as target_entropy:
+    # any concrete float is a legitimate hand-set bound.
     v_min: float = -150.0
     v_max: float = 150.0
 
@@ -152,6 +160,14 @@ class DDPGConfig:
     # sweep point would silently measure the same thing. Wall-clock only:
     # the algorithmic quantity (grad steps per env step) is unchanged.
     actor_throttle_s: float = 0.0
+    # Lockstep debug mode (SURVEY.md §5 race detection): actors run INLINE
+    # on the driver thread (actors/sync_pool.py) in deterministic
+    # round-robin order, eval runs synchronously, and the wall-clock floors
+    # on param refresh / metrics logging are ignored — two runs of the same
+    # config produce bit-identical metrics, so any divergence against an
+    # async run isolates a race in the async machinery. Requires both
+    # ratio gates armed (the drain budget is the deterministic schedule).
+    strict_sync: bool = False
     param_refresh_every: int = 1     # learner steps between actor param refresh
     # Wall-clock floor between actor param broadcasts in train_jax. A
     # broadcast must sync the in-flight chunk and round-trip params
@@ -239,6 +255,13 @@ class DDPGConfig:
                     type=lambda s: tuple(int(x) for x in s.split(",")),
                     default=field.default,
                 )
+            elif field.name in ("v_min", "v_max"):
+                # "auto" -> nan sentinel (warmup-derived support sizing).
+                parser.add_argument(
+                    f"--{field.name}",
+                    type=lambda s: float("nan") if s == "auto" else float(s),
+                    default=field.default,
+                )
             else:
                 ftype = {"int": int, "float": float, "str": str}.get(
                     str(field.type), str
@@ -246,6 +269,13 @@ class DDPGConfig:
                 parser.add_argument(f"--{field.name}", type=ftype, default=field.default)
         args = parser.parse_args(argv)
         return cls(**vars(args))
+
+    @property
+    def v_support_auto(self) -> bool:
+        """True when the C51 support is auto-sized (v_min/v_max = nan).
+        Consumers must resolve concrete bounds (support_auto.initial_bounds)
+        before building a learner step — linspace over nan is all-nan."""
+        return math.isnan(self.v_min)
 
     def __post_init__(self):
         if self.backend not in ("native", "jax_tpu", "jax_ondevice"):
@@ -285,6 +315,35 @@ class DDPGConfig:
                 "policy_delay/target_noise are TD3 knobs consumed only by "
                 "the twin-critic step — set twin_critic=True or they would "
                 "silently do nothing"
+            )
+        v_min_auto = math.isnan(self.v_min)
+        v_max_auto = math.isnan(self.v_max)
+        if v_min_auto != v_max_auto:
+            raise ValueError(
+                "v_min/v_max auto-sizing derives BOTH bounds from the same "
+                "warmup statistics — set both to 'auto' or neither"
+            )
+        if v_min_auto and not self.distributional:
+            raise ValueError(
+                "v_min/v_max='auto' sizes the distributional critic's "
+                "support; it requires distributional=True"
+            )
+        if v_min_auto and not 0.0 < self.gamma < 1.0:
+            raise ValueError(
+                f"v_min/v_max='auto' needs 0 < gamma < 1 (got {self.gamma}): "
+                "the sizing bound r/(1-gamma^n) blows up at gamma=1, and 51 "
+                "atoms over a near-infinite range cannot resolve real "
+                "returns — pass concrete bounds for undiscounted setups"
+            )
+        if v_min_auto and self.backend == "jax_ondevice":
+            raise ValueError(
+                "v_min/v_max='auto' sizes the support from host-visible "
+                "warmup replay rewards; the fused on-device backend has no "
+                "such window — pass concrete bounds"
+            )
+        if not v_min_auto and self.distributional and self.v_min >= self.v_max:
+            raise ValueError(
+                f"v_min ({self.v_min}) must be < v_max ({self.v_max})"
             )
         if self.twin_critic and self.distributional:
             raise ValueError(
@@ -329,6 +388,27 @@ class DDPGConfig:
             raise ValueError("max_learn_ratio must be >= 0 (0 = unlimited)")
         if self.actor_throttle_s < 0:
             raise ValueError("actor_throttle_s must be >= 0 (0 = off)")
+        if self.strict_sync:
+            if self.backend != "jax_tpu":
+                raise ValueError(
+                    "strict_sync is a train_jax (jax_tpu backend) debug "
+                    "mode; the native backend is already single-threaded "
+                    "and deterministic, and the fused on-device backend "
+                    "has no host actor loop to make lockstep"
+                )
+            if self.max_learn_ratio <= 0 or self.max_ingest_ratio <= 0:
+                raise ValueError(
+                    "strict_sync derives its deterministic ingest schedule "
+                    "from the ratio gates; set max_learn_ratio and "
+                    "max_ingest_ratio (1.0 each = the reference's "
+                    "synchronous 1:1 schedule)"
+                )
+            if self.host_replay:
+                raise ValueError(
+                    "strict_sync requires the device replay path: the host "
+                    "prefetch thread samples concurrently with ingest, "
+                    "which is exactly the nondeterminism this mode removes"
+                )
         if self.warmup_uniform_steps < -1:
             raise ValueError(
                 "warmup_uniform_steps must be >= -1 (-1 = auto, 0 = off)"

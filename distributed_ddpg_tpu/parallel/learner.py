@@ -126,6 +126,40 @@ class ShardedLearner:
         self._batch_sharding = NamedSharding(self.mesh, P("data", None))
         self._chunk_sharding = NamedSharding(self.mesh, P(None, "data", None))
         self.state: TrainState = jax.device_put(state, self._state_sharding)
+        self._action_scale = action_scale
+        self._action_offset = action_offset
+        self._build_programs()
+        self._key = jax.device_put(
+            jax.random.PRNGKey(config.seed),
+            NamedSharding(self.mesh, P()),
+        )
+
+    def set_value_bounds(self, v_min: float, v_max: float) -> None:
+        """Swap the C51 support bounds and rebuild the (lazily compiled)
+        chunk programs in place. Mesh, state, and the sampling key are
+        untouched, so the training stream continues exactly where it was;
+        the next dispatch pays one XLA recompile. The auto-support
+        controller (config.v_support_auto, ops/support_auto.py) calls this
+        once at warmup resolution and on each geometric expansion — O(log)
+        times per run."""
+        self.config = self.config.replace(v_min=float(v_min), v_max=float(v_max))
+        self._build_programs()
+
+    def _build_programs(self) -> None:
+        """Build every jitted step/chunk program from self.config. jax.jit
+        is lazy, so (re)building costs nothing until the next dispatch."""
+        # A Mosaic/kernel failure recorded by an earlier dispatch must
+        # survive a rebuild: re-arming the fused path would re-pay the
+        # known-failing multi-second compile on every support expansion AND
+        # wipe the fused_chunk_error diagnostic that tpu_child/multihost
+        # probes read afterwards.
+        prior_kernel_error = getattr(self, "fused_chunk_error", None)
+        config = self.config
+        mode = self.mode
+        obs_dim, act_dim = self.obs_dim, self.act_dim
+        action_scale = self._action_scale
+        action_offset = self._action_offset
+        state = self.state
 
         if mode == "auto":
             step = make_learner_step(config, action_scale, action_offset=action_offset)
@@ -404,7 +438,15 @@ class ShardedLearner:
         )
         self._sample_chunk_compiled = False
         self.fused_chunk_error: Optional[str] = None
-        self._key = jax.device_put(jax.random.PRNGKey(config.seed), replicated)
+        if prior_kernel_error is not None:
+            # Stay degraded (see note at the top of this method) — same
+            # assignments as the run_sample_chunk fallback branch.
+            self.fused_chunk_error = prior_kernel_error
+            self.fused_chunk_active = False
+            self.fused_mesh_active = False
+            self.fused_per_active = False
+            self._sample_chunk_step = self._scan_sample_chunk_step
+            self._per_sample_chunk_step = self._scan_per_sample_chunk_step
 
     def _make_fused_mesh_fn(self, fused_chunk_lib, action_scale, action_offset):
         """Megakernel x data-parallel mesh (VERDICT.md r3 Missing #3).

@@ -120,6 +120,22 @@ class DeviceReplay:
     def __len__(self) -> int:
         return int(jax.device_get(self.size))
 
+    def reward_sample(self, max_n: int = 100_000) -> np.ndarray:
+        """Stored (n-step) reward column, up to max_n rows, pulled to host —
+        feeds the C51 auto-support sizing (ops/support_auto.initial_bounds).
+        One bounded d2h outside the hot loop. Multi-process: REPLICATED
+        storage only — _pending holds process-LOCAL un-shipped rows, and
+        per-process bounds derived from them would compile different
+        Bellman targets per replica (the replica fork this module's insert
+        discipline exists to prevent). Single-process includes _pending so
+        a just-warmed buffer is fully represented."""
+        col = self.obs_dim + self.act_dim
+        n = min(len(self), max_n)
+        parts = [np.asarray(jax.device_get(self.storage[:n, col]))]
+        if self._procs == 1 and len(self._pending):
+            parts.append(self._pending[:max_n, col])
+        return np.concatenate(parts)
+
     @property
     def pending_rows(self) -> int:
         """Host-side rows buffered but not yet shipped (multi-host: waiting

@@ -37,6 +37,11 @@ class UniformReplay:
     def __len__(self) -> int:
         return self._size
 
+    def reward_sample(self, max_n: int = 100_000) -> np.ndarray:
+        """Stored (n-step) reward column, up to max_n rows — feeds the
+        C51 auto-support sizing (ops/support_auto.initial_bounds)."""
+        return self.reward[: min(self._size, max_n)].copy()
+
     def add_batch(self, obs, action, reward, discount, next_obs) -> np.ndarray:
         """Insert B transitions; returns the slots written (for PER subclass)."""
         obs = np.atleast_2d(obs)

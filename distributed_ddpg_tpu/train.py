@@ -28,6 +28,7 @@ from distributed_ddpg_tpu import checkpoint as ckpt_lib
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs import make, spec_of
 from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, Timer
+from distributed_ddpg_tpu.ops import support_auto
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
 
@@ -430,7 +431,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     else:
         device_replay = None
     replay = None if use_device_replay else make_replay(config, spec.obs_dim, spec.act_dim)
-    pool = ActorPool(config, spec)
+    if config.strict_sync:
+        # Lockstep debug mode (config.strict_sync): inline deterministic
+        # actors — same surface, no processes, no races to win.
+        from distributed_ddpg_tpu.actors.sync_pool import SyncActorPool
+
+        pool = SyncActorPool(config, spec)
+    else:
+        pool = ActorPool(config, spec)
     # --- resume (SURVEY.md §3.5/§5: learner restart = checkpoint restore;
     # unlike the reference, replay contents come back too). The saved config
     # is validated first; env-step progress carries over so the TOTAL budget
@@ -442,14 +450,28 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         and config.checkpoint_dir
         and ckpt_lib.latest_step(config.checkpoint_dir) is not None
     ):
+        ckpt_meta: Dict[str, object] = {}
         restored, step, env_steps_offset = ckpt_lib.restore(
             config.checkpoint_dir,
             learner.state,
             device_replay if use_device_replay else replay,
             config=config,
+            meta_out=ckpt_meta,
         )
         learner.state = jax.device_put(restored, learner._state_sharding)
         learn_steps = step
+        if config.distributional and config.v_support_auto:
+            # The RESOLVED support bounds ride the checkpoint: the restored
+            # critic's logits are only meaningful over the atom values they
+            # were trained against — re-deriving from reward statistics
+            # cannot recover mean_q-driven expansions. Old checkpoints
+            # without the field fall back to warmup re-derivation below.
+            if "v_bounds" in ckpt_meta:
+                learner.set_value_bounds(*ckpt_meta["v_bounds"])
+                print(
+                    "auto C51 support restored from checkpoint: "
+                    f"[{learner.config.v_min:.1f}, {learner.config.v_max:.1f}]"
+                )
         # Resumed progress counts against the uniform-warmup budget
         # (pool._spawn) — no random-action re-injection mid-training.
         pool.env_steps_offset = env_steps_offset
@@ -505,6 +527,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             policy.load_flat(flat)
             log.log("eval", at_step, eval_return=_eval_numpy(policy, config, spec))
 
+        if config.strict_sync:
+            # Lockstep mode: eval runs synchronously so the metrics stream
+            # (content AND order) is a pure function of the config.
+            _run()
+            return
         t = threading.Thread(target=_run, name="eval-worker", daemon=True)
         t.start()
         eval_thread["t"] = t
@@ -597,6 +624,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     last_eval = 0
     last_refresh_t = 0.0
     last_log_t = 0.0
+    support_controller = support_auto.SupportController()
 
     def after_chunk(out, indices) -> None:
         nonlocal learn_steps, last_ckpt, next_refresh, last_eval
@@ -617,25 +645,65 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         # refresh's pipeline-sync + d2h cost to a fixed fraction of wall
         # time — without it a per-chunk broadcast serializes the device
         # pipeline (each one waits out the in-flight chunk).
+        # strict_sync ignores the wall-clock floors on refresh and logging:
+        # both would make the training schedule (which params act, which
+        # chunks log) a function of host timing instead of the config,
+        # breaking the bit-identical-two-runs contract.
         now = time.perf_counter()
-        if (
-            learn_steps >= next_refresh
-            and now - last_refresh_t >= config.param_refresh_interval_s
+        if learn_steps >= next_refresh and (
+            config.strict_sync
+            or now - last_refresh_t >= config.param_refresh_interval_s
         ):
             with phases.phase("refresh"):
                 pool.broadcast(learner.actor_params_to_host(), learn_steps)
             next_refresh = learn_steps + config.param_refresh_every
             last_refresh_t = time.perf_counter()
 
-        if learn_steps % (50 * chunk) == 0 and now - last_log_t >= 1.0:
+        on_cadence = learn_steps % (50 * chunk) == 0
+        chunk_metrics = None
+        support_metrics = {}
+        if on_cadence and config.distributional and config.v_support_auto:
+            # Running expansion (ops/support_auto.py): mean_q drifting
+            # toward a support edge means the critic is about to saturate
+            # (projection clips, mean_q can never cross the edge) — push
+            # that edge out geometrically. The check sits OUTSIDE the
+            # wall-clock log gate below: the cadence and mean_q (pmean'd,
+            # replicated) are identical on every process, so every replica
+            # takes the same expansion on the same chunk — a per-process
+            # wall-clock gate here would rebuild programs on some replicas
+            # only and fork the mesh. Each expansion costs one XLA
+            # recompile at the next dispatch, granted to the watchdog like
+            # the initial compile.
+            with phases.phase("sync"):
+                chunk_metrics = learner.metrics_to_host(out)
+            grown = support_controller.check(
+                learner.config.v_min,
+                learner.config.v_max,
+                chunk_metrics["mean_q"],
+                learn_steps,
+            )
+            if grown is not None:
+                learner.set_value_bounds(*grown)
+                _grant(max(300.0, 2.0 * config.watchdog_s))
+                print(
+                    f"auto C51 support expanded to "
+                    f"[{grown[0]:.1f}, {grown[1]:.1f}] "
+                    f"(mean_q {chunk_metrics['mean_q']:.1f})"
+                )
+            support_metrics = dict(
+                v_min=learner.config.v_min, v_max=learner.config.v_max
+            )
+
+        if on_cadence and (config.strict_sync or now - last_log_t >= 1.0):
             last_log_t = now
             pool.monitor()
             episodes = pool.episode_stats()
             mean_ret = (
                 float(np.mean([e[1] for e in episodes])) if episodes else None
             )
-            with phases.phase("sync"):
-                chunk_metrics = learner.metrics_to_host(out)
+            if chunk_metrics is None:
+                with phases.phase("sync"):
+                    chunk_metrics = learner.metrics_to_host(out)
             log.log(
                 "train", env_steps(),
                 learner_steps=learn_steps,
@@ -645,6 +713,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 episode_return=mean_ret,
                 **pool.staleness(),
                 **chunk_metrics,
+                **support_metrics,
                 **phases.snapshot(),
             )
 
@@ -669,6 +738,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     config.checkpoint_dir, learn_steps, learner.state,
                     device_replay if use_device_replay else replay, config,
                     env_steps=env_steps(),
+                    v_bounds=(
+                        (learner.config.v_min, learner.config.v_max)
+                        if config.distributional and config.v_support_auto
+                        else None
+                    ),
                 )
             last_ckpt = learn_steps
 
@@ -730,6 +804,23 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 _check_actor_stall("warmup")
                 time.sleep(0.05)
             warm_it += 1
+
+        if config.distributional and learner.config.v_support_auto:
+            # C51 auto-support (ops/support_auto.py): size [v_min, v_max]
+            # from the warmup replay's (n-step) reward statistics. Gated on
+            # the LEARNER's config: a resume that restored checkpointed
+            # bounds above has already resolved them, and re-deriving would
+            # reinterpret the restored critic. Must happen before the first
+            # dispatch: jit is lazy, so the rebuild costs no extra compile.
+            source = device_replay if use_device_replay else replay
+            v_lo, v_hi = support_auto.initial_bounds(
+                source.reward_sample(), config.gamma, config.n_step
+            )
+            learner.set_value_bounds(v_lo, v_hi)
+            print(
+                f"auto C51 support: [{v_lo:.1f}, {v_hi:.1f}] from warmup "
+                "reward statistics"
+            )
 
         prefetch = None
         if not use_device_replay:

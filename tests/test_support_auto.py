@@ -1,0 +1,282 @@
+"""C51 auto-support sizing (ops/support_auto.py; VERDICT r4 Weak #4 / Next #7).
+
+The hand-tuned supports this replaces (docs/EVIDENCE.md §3): Pendulum
+[-1600, 0], LunarLander ±400, HalfCheetah widened to [-100, 1000] after the
+±150 default saturated at Q≈600. The tests pin the auto rules to those
+values: initial sizing from real builtin-Pendulum warmup rewards must land
+in the hand-tuned ballpark, and the expansion rule must grow a warmup-sized
+HalfCheetah support past the trained Q range.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.ops import support_auto
+
+
+def _pendulum_warmup_rewards(n: int = 5000, seed: int = 0) -> np.ndarray:
+    from distributed_ddpg_tpu.envs import make
+
+    env = make("Pendulum-v1", seed=seed, prefer_builtin=True)
+    rng = np.random.default_rng(seed)
+    obs, _ = env.reset(seed=seed)
+    rewards = []
+    for _ in range(n):
+        action = rng.uniform(-2.0, 2.0, size=(1,)).astype(np.float32)
+        obs, r, term, trunc, _ = env.step(action)
+        rewards.append(r)
+        if term or trunc:
+            obs, _ = env.reset()
+    return np.asarray(rewards, np.float32)
+
+
+class TestInitialBounds:
+    def test_pendulum_matches_hand_tuned(self):
+        # Hand-tuned support: [-1600, 0]. Dense all-negative rewards in
+        # [-16.3, 0] with gamma 0.99 must reproduce that geometry from data.
+        v_min, v_max = support_auto.initial_bounds(
+            _pendulum_warmup_rewards(), gamma=0.99, n_step=1
+        )
+        assert -2500.0 <= v_min <= -1000.0
+        assert 0.0 <= v_max <= 400.0
+
+    def test_sparse_terminal_rewards_inside_support(self):
+        # LunarLander-style: small dense shaping plus rare ±100 terminals.
+        # The raw extremes must be inside the support even though the 1/99
+        # percentiles clip them away.
+        rng = np.random.default_rng(1)
+        r = rng.normal(0.0, 1.0, size=10_000)
+        r[::500] = 100.0
+        r[250::500] = -100.0
+        v_min, v_max = support_auto.initial_bounds(r, gamma=0.99, n_step=1)
+        assert v_min <= -100.0
+        assert v_max >= 100.0
+
+    def test_nstep_rewards_use_effective_discount(self):
+        # n-step rewards are ~n× larger but bootstrap through gamma^n; the
+        # two effects cancel, so 1-step and 3-step sizing must agree to
+        # within the margin factor, not differ by ~n×.
+        rng = np.random.default_rng(2)
+        r1 = rng.uniform(-1.0, 0.0, size=5000)
+        lo1, _ = support_auto.initial_bounds(r1, gamma=0.99, n_step=1)
+        lo3, _ = support_auto.initial_bounds(3.0 * r1, gamma=0.99, n_step=3)
+        assert 0.5 < lo3 / lo1 < 2.0
+
+    def test_degenerate_rewards_get_floor_width(self):
+        v_min, v_max = support_auto.initial_bounds(
+            np.zeros(100), gamma=0.99, n_step=1
+        )
+        assert v_max - v_min >= 2 * support_auto.MIN_HALF_WIDTH - 1e-9
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError):
+            support_auto.initial_bounds(np.array([np.nan]), 0.99)
+
+
+class TestMaybeExpand:
+    def test_cheetah_growth_covers_trained_q(self):
+        # Warmup random-policy sizing gives HalfCheetah roughly ±200; the
+        # trained critic reaches Q ≈ 600 (docs/EVIDENCE.md §3 — the ±150
+        # saturation incident). Feeding the climbing mean_q must grow v_max
+        # past the hand-tuned 1000 in a handful of geometric expansions.
+        v_min, v_max = -200.0, 200.0
+        expansions = 0
+        for q in [50.0, 150.0, 400.0, 600.0, 601.0, 602.0]:
+            grown = support_auto.maybe_expand(v_min, v_max, q)
+            if grown is not None:
+                v_min, v_max = grown
+                expansions += 1
+        assert v_max >= 1000.0
+        assert v_min == -200.0  # low edge never approached, never moved
+        assert expansions <= 3  # geometric, not incremental
+
+    def test_centered_q_is_stable(self):
+        assert support_auto.maybe_expand(-150.0, 150.0, 0.0) is None
+        assert support_auto.maybe_expand(-150.0, 150.0, 80.0) is None
+
+    def test_negative_drift_expands_low_edge(self):
+        grown = support_auto.maybe_expand(-150.0, 150.0, -140.0)
+        assert grown is not None
+        v_min, v_max = grown
+        assert v_min < -150.0 and v_max == 150.0
+
+    def test_nan_mean_q_is_ignored(self):
+        assert support_auto.maybe_expand(-150.0, 150.0, float("nan")) is None
+
+    def test_cooldown_blocks_the_reinterpretation_cascade(self):
+        # The stretch is affine with unchanged logits, so right after an
+        # expansion the reinterpreted mean_q sits at EXACTLY the same
+        # fraction of the new half-range — an immediate re-check would
+        # re-fire forever. The cooldown must hold it until SGD has had the
+        # relearn horizon.
+        lo, hi, mean_q = -10.0, 10.0, 7.5
+        grown = support_auto.maybe_expand(lo, hi, mean_q)
+        assert grown is not None
+        new_lo, new_hi = grown
+        # z' = lo + (z - lo) * (new_range / old_range): the critic's
+        # unchanged distribution now decodes to the stretched mean_q.
+        mean_q2 = new_lo + (mean_q - lo) * (new_hi - new_lo) / (hi - lo)
+        # Invariance: same fraction of the new half-range (the bug's core).
+        frac = lambda a, b, q: (q - 0.5 * (a + b)) / (0.5 * (b - a))
+        assert abs(frac(new_lo, new_hi, mean_q2) - frac(lo, hi, mean_q)) < 1e-9
+        assert (
+            support_auto.maybe_expand(
+                new_lo, new_hi, mean_q2, steps_since_expansion=50
+            )
+            is None
+        )
+        assert (
+            support_auto.maybe_expand(
+                new_lo, new_hi, mean_q2,
+                steps_since_expansion=support_auto.COOLDOWN_STEPS,
+            )
+            is not None
+        )
+
+
+class TestConfigPlumbing:
+    def test_auto_flag_parses_to_nan(self):
+        c = DDPGConfig.from_flags(
+            ["--distributional=true", "--v_min=auto", "--v_max=auto"]
+        )
+        assert math.isnan(c.v_min) and math.isnan(c.v_max)
+        assert c.v_support_auto
+
+    def test_concrete_flags_still_parse(self):
+        c = DDPGConfig.from_flags(
+            ["--distributional=true", "--v_min=-400", "--v_max=400"]
+        )
+        assert c.v_min == -400.0 and not c.v_support_auto
+
+    def test_single_sided_auto_rejected(self):
+        with pytest.raises(ValueError, match="BOTH"):
+            DDPGConfig(
+                distributional=True, v_min=float("nan"), v_max=150.0
+            )
+
+    def test_auto_requires_distributional(self):
+        with pytest.raises(ValueError, match="distributional"):
+            DDPGConfig(v_min=float("nan"), v_max=float("nan"))
+
+    def test_inverted_concrete_bounds_rejected(self):
+        with pytest.raises(ValueError, match="v_min"):
+            DDPGConfig(distributional=True, v_min=150.0, v_max=-150.0)
+
+    def test_checkpoint_compat_treats_nan_as_equal(self):
+        from distributed_ddpg_tpu.checkpoint import _compat_eq
+
+        assert _compat_eq(float("nan"), float("nan"))
+        assert _compat_eq(1.0, 1.0)
+        assert not _compat_eq(float("nan"), 1.0)
+        assert not _compat_eq(1.0, 2.0)
+
+
+class TestBoundsPersistence:
+    def test_resolved_bounds_ride_the_checkpoint(self, tmp_path):
+        # Expansion-derived bounds are unrecoverable from reward stats, so
+        # restore must hand back exactly what was saved — and checkpoints
+        # written without the field must restore cleanly without it.
+        from distributed_ddpg_tpu import checkpoint as ckpt_lib
+        from distributed_ddpg_tpu.learner import init_train_state
+
+        config = DDPGConfig(
+            distributional=True, actor_hidden=(8, 8), critic_hidden=(8, 8)
+        )
+        state = init_train_state(config, 3, 1, seed=0)
+        ckpt_lib.save(
+            str(tmp_path / "auto"), 7, state, None, config,
+            v_bounds=(-200.0, 1400.0),
+        )
+        meta = {}
+        _, step, _ = ckpt_lib.restore(
+            str(tmp_path / "auto"), state, meta_out=meta
+        )
+        assert step == 7
+        assert meta["v_bounds"] == (-200.0, 1400.0)
+
+        ckpt_lib.save(str(tmp_path / "plain"), 9, state, None, config)
+        meta = {}
+        ckpt_lib.restore(str(tmp_path / "plain"), state, meta_out=meta)
+        assert "v_bounds" not in meta
+
+
+class TestAgentIntegration:
+    def test_pendulum_agent_resolves_and_trains(self):
+        # End-to-end on builtin Pendulum: the agent must resolve concrete
+        # bounds at the first train step (warmup-reward sizing), keep them
+        # in the hand-tuned ballpark, and produce finite metrics.
+        from distributed_ddpg_tpu.agent import DDPGAgent
+        from distributed_ddpg_tpu.envs import make, spec_of
+
+        config = DDPGConfig(
+            distributional=True,
+            v_min=float("nan"),
+            v_max=float("nan"),
+            actor_hidden=(32, 32),
+            critic_hidden=(32, 32),
+            replay_min_size=400,
+            batch_size=32,
+            total_env_steps=600,
+        )
+        env = make(config.env_id, seed=0, prefer_builtin=True)
+        agent = DDPGAgent(config, spec_of(env))
+        obs, _ = env.reset(seed=0)
+        metrics = None
+        for _ in range(600):
+            action = agent.act(obs)
+            next_obs, r, term, trunc, _ = env.step(action)
+            agent.observe(obs, action, r, term, next_obs)
+            obs = next_obs
+            if term or trunc:
+                obs, _ = env.reset()
+                agent.reset_episode()
+            m = agent.train_step()
+            if m is not None:
+                metrics = m
+        assert metrics is not None
+        assert not agent.config.v_support_auto  # resolved to concrete floats
+        assert -3000.0 <= agent.config.v_min <= -500.0
+        assert agent.config.v_max <= 500.0
+        assert np.isfinite(metrics["critic_loss"])
+        assert np.isfinite(metrics["mean_q"])
+
+
+class TestShardedLearnerRebuild:
+    def test_set_value_bounds_rebuilds_and_trains(self):
+        # An auto-config learner builds (lazily — nan bounds never trace),
+        # resolves via set_value_bounds, and the rebuilt chunk program
+        # trains with finite metrics on the new support.
+        import jax
+
+        from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+        from distributed_ddpg_tpu.types import pack_batch_np
+
+        config = DDPGConfig(
+            distributional=True,
+            v_min=float("nan"),
+            v_max=float("nan"),
+            actor_hidden=(16, 16),
+            critic_hidden=(16, 16),
+            batch_size=8,
+            scale_batch_with_data=False,
+        )
+        obs_dim, act_dim = 3, 1
+        learner = ShardedLearner(config, obs_dim, act_dim, 1.0, chunk_size=2)
+        learner.set_value_bounds(-120.0, 40.0)
+        rng = np.random.default_rng(0)
+        chunk = {
+            "obs": rng.standard_normal((2, 8, obs_dim)).astype(np.float32),
+            "action": rng.uniform(-1, 1, (2, 8, act_dim)).astype(np.float32),
+            "reward": rng.uniform(-1, 0, (2, 8)).astype(np.float32),
+            "discount": np.full((2, 8), 0.99, np.float32),
+            "next_obs": rng.standard_normal((2, 8, obs_dim)).astype(np.float32),
+        }
+        out = learner.run_chunk(chunk)
+        metrics = learner.metrics_to_host(out)
+        assert np.isfinite(metrics["critic_loss"])
+        # mean_q lives on the resolved support
+        assert -120.0 <= metrics["mean_q"] <= 40.0
+        assert learner.config.v_min == -120.0
