@@ -1,0 +1,33 @@
+#!/bin/bash
+# D4PG auto-support validation (VERDICT r4 Next #7 done-criterion): rerun
+# the hand-sized D4PG quality points with --v_min=auto --v_max=auto and
+# compare against the hand-tuned records:
+#   lunar    rung-2 protocol (4 actors, 1:1 gates, n_step=3, 300k)
+#            hand: support ±400 -> final 272.9 (runs/r4_d4pg_lunar.jsonl)
+#   cheetah  gap topology (1 actor, 1:1 gates, n_step=3, 300k, seed 0)
+#            hand: [-100,1000] -> final 3751 (runs/r4_d4pg_cheetah.jsonl)
+# Auto must land in the same ballpark WITHOUT the operator knowing the
+# env's return range (ops/support_auto.py: warmup sizing + mean_q-driven
+# geometric expansion). nice -n 10 keeps the TPU recovery queue first.
+set -u
+cd "$(dirname "$0")/.."
+BASE="env JAX_PLATFORMS=cpu nice -n 10 python -m distributed_ddpg_tpu.train
+  --distributional=true --v_min=auto --v_max=auto --n_step=3
+  --actor_hidden=256,256 --critic_hidden=256,256
+  --max_learn_ratio=1.0 --max_ingest_ratio=1.0 --watchdog_s=300
+  --total_env_steps=300000"
+
+run() {  # run <tag> <extra flags...>
+  local tag=$1; shift
+  local log="runs/r5_d4pg_auto_${tag}.jsonl"
+  if [ -f "$log" ] && grep -q '"kind": "final"' "$log"; then
+    echo "SKIP $tag (final record already present)"; return
+  fi
+  echo "START $tag $(date -u +%H:%M:%SZ)"
+  $BASE "$@" --log_path="$log" > "runs/r5_d4pg_auto_${tag}.out" 2>&1
+  echo "DONE $tag rc=$? $(date -u +%H:%M:%SZ) final: $(grep '"kind": "final"' "$log" | tail -1)"
+  grep -o "auto C51 support[^\"]*" "runs/r5_d4pg_auto_${tag}.out" | head -5
+}
+
+run lunar   --env_id=LunarLanderContinuous-v2 --num_actors=4
+run cheetah --env_id=HalfCheetah-v4 --num_actors=1

@@ -1,0 +1,47 @@
+#!/bin/bash
+# TD3 rung-2 (LunarLanderContinuous-v2) tuning study — VERDICT r4 Weak #3 /
+# Next #5: with DEFAULT hyperparameters TD3 finished 81.4 @300k with ±150
+# eval swings (runs/r4_td3_lunar.jsonl) while SAC (252.5) and D4PG (272.9)
+# solve the rung. Mechanism hypothesis (docs/EVIDENCE.md): the env's
+# land-vs-crash bimodality punishes a deterministic policy with small
+# smoothing noise. Attempts target exactly those knobs, budget >=500k:
+#
+#   a_nstep3   n_step=3            — the fix that solved rung 3 for DDPG and
+#                                    rung 2/3 for D4PG: shorter bootstrap
+#                                    chains across the terminal land/crash
+#                                    discontinuity.
+#   b_sigma35  n_step=3 + ou_sigma=0.35
+#                                  — broader exploration so landings are
+#                                    actually visited early.
+#   c_smooth3  n_step=3 + target_noise=0.3/clip 0.6
+#                                  — wider target smoothing so the critic
+#                                    target averages across the bimodal
+#                                    outcome instead of riding one mode.
+#
+# Rung-2 protocol pinned (BASELINE.json:9 via ladder.py RUNGS[2]): 4 actors,
+# 256x256 nets, learn/ingest ratio 1.0, uniform replay. nice -n 10 so the
+# TPU recovery runbook keeps priority on this 1-core host.
+set -u
+cd "$(dirname "$0")/.."
+STEPS="${STEPS:-500000}"
+BASE="env JAX_PLATFORMS=cpu nice -n 10 python -m distributed_ddpg_tpu.train
+  --env_id=LunarLanderContinuous-v2 --backend=jax_tpu --num_actors=4
+  --actor_hidden=256,256 --critic_hidden=256,256
+  --max_learn_ratio=1.0 --max_ingest_ratio=1.0 --watchdog_s=300
+  --twin_critic=true --policy_delay=2 --target_noise=0.2
+  --total_env_steps=$STEPS"
+
+run() {  # run <tag> <extra flags...>
+  local tag=$1; shift
+  local log="runs/r5_td3_lunar_${tag}.jsonl"
+  if [ -f "$log" ] && grep -q '"kind": "final"' "$log"; then
+    echo "SKIP $tag (final record already present)"; return
+  fi
+  echo "START $tag $(date -u +%H:%M:%SZ)"
+  $BASE "$@" --log_path="$log" > "runs/r5_td3_lunar_${tag}.out" 2>&1
+  echo "DONE $tag rc=$? $(date -u +%H:%M:%SZ) final: $(grep '"kind": "final"' "$log" | tail -1)"
+}
+
+run a_nstep3  --n_step=3
+run b_sigma35 --n_step=3 --ou_sigma=0.35
+run c_smooth3 --n_step=3 --target_noise=0.3 --target_noise_clip=0.6
