@@ -122,9 +122,10 @@ class DDPGAgent:
             # has happened yet, so this costs nothing extra. After this the
             # config carries concrete bounds and the branch never re-enters.
             # Running expansion: the SupportController check further down.
+            rewards, discounts = self.replay.reward_sample()
             v_lo, v_hi = support_auto.initial_bounds(
-                self.replay.reward_sample(), self.config.gamma,
-                self.config.n_step,
+                rewards, self.config.gamma, self.config.n_step,
+                discounts=discounts,
             )
             self._set_value_bounds(v_lo, v_hi)
         sample = self.replay.sample(self.config.batch_size)

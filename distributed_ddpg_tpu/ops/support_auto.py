@@ -64,22 +64,46 @@ MIN_HALF_WIDTH = 1.0
 
 
 def initial_bounds(
-    rewards: np.ndarray, gamma: float, n_step: int = 1
+    rewards: np.ndarray,
+    gamma: float,
+    n_step: int = 1,
+    discounts: Optional[np.ndarray] = None,
 ) -> Tuple[float, float]:
     """Derive [v_min, v_max] from observed (n-step) rewards.
 
     rewards: the replay's stored reward column — n-step accumulated sums
     when n_step > 1, matching what the Bellman target actually adds.
+    discounts: the matching stored discount column, when available. A
+    terminal transition (discount == 0) carries a ONE-OFF reward by
+    definition — nothing bootstraps through it — so it must not enter the
+    persistent-reward bound r/(1-gamma^n): LunarLander's random-policy
+    warmup crashes (-100 terminal) are frequent enough to land inside the
+    1st percentile, and multiplying them by the ~34-step horizon sized the
+    support to [-3731, 639] where the hand value was ±400 (measured,
+    round 5). With the terminal mask they only enter via the raw-extreme
+    term (a -100 crash must still be inside the support, as ±100 itself).
     """
     r = np.asarray(rewards, np.float64)
-    r = r[np.isfinite(r)]
+    finite = np.isfinite(r)
+    if discounts is not None:
+        d = np.asarray(discounts, np.float64)
+        nonterm = r[finite & (d > 0.0)]
+    else:
+        nonterm = r[finite]
+    r = r[finite]
     if r.size == 0:
         raise ValueError("initial_bounds needs at least one finite reward")
     # Effective per-transition discount: stored n-step rewards bootstrap
     # through gamma^n, so the persistent-reward return bound is r/(1-gamma^n).
     g_eff = float(gamma) ** int(n_step)
     horizon = 1.0 / max(1.0 - g_eff, 1e-6)
-    r_lo, r_hi = np.percentile(r, [1.0, 99.0])
+    if nonterm.size == 0:
+        # All-terminal warmup (bandit-style env): NOTHING bootstraps, true
+        # returns ARE the raw rewards — the horizon term would oversize the
+        # support ~100x and park the whole value function inside one atom.
+        r_lo = r_hi = 0.0
+    else:
+        r_lo, r_hi = np.percentile(nonterm, [1.0, 99.0])
     # Each side: the persistent-reward bound from the robust percentile OR
     # the raw extreme (sparse terminal rewards are outliers the percentile
     # clips away, but a single +100 landing bonus must still be inside the

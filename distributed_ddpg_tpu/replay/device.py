@@ -120,9 +120,11 @@ class DeviceReplay:
     def __len__(self) -> int:
         return int(jax.device_get(self.size))
 
-    def reward_sample(self, max_n: int = 100_000) -> np.ndarray:
-        """Stored (n-step) reward column, up to max_n rows, pulled to host —
-        feeds the C51 auto-support sizing (ops/support_auto.initial_bounds).
+    def reward_sample(self, max_n: int = 100_000):
+        """(reward, discount) columns, up to max_n rows, pulled to host —
+        feeds the C51 auto-support sizing (ops/support_auto.initial_bounds;
+        discount==0 marks terminal transitions, whose one-off rewards must
+        not enter the persistent-reward bound).
         One bounded d2h outside the hot loop. Multi-process: REPLICATED
         storage only — _pending holds process-LOCAL un-shipped rows, and
         per-process bounds derived from them would compile different
@@ -131,10 +133,10 @@ class DeviceReplay:
         a just-warmed buffer is fully represented."""
         col = self.obs_dim + self.act_dim
         n = min(len(self), max_n)
-        parts = [np.asarray(jax.device_get(self.storage[:n, col]))]
+        cols = np.asarray(jax.device_get(self.storage[:n, col : col + 2]))
         if self._procs == 1 and len(self._pending):
-            parts.append(self._pending[:max_n, col])
-        return np.concatenate(parts)
+            cols = np.concatenate([cols, self._pending[:max_n, col : col + 2]])
+        return cols[:, 0], cols[:, 1]
 
     @property
     def pending_rows(self) -> int:

@@ -37,10 +37,13 @@ class UniformReplay:
     def __len__(self) -> int:
         return self._size
 
-    def reward_sample(self, max_n: int = 100_000) -> np.ndarray:
-        """Stored (n-step) reward column, up to max_n rows — feeds the
-        C51 auto-support sizing (ops/support_auto.initial_bounds)."""
-        return self.reward[: min(self._size, max_n)].copy()
+    def reward_sample(self, max_n: int = 100_000):
+        """(reward, discount) columns, up to max_n rows — feeds the C51
+        auto-support sizing (ops/support_auto.initial_bounds; the discount
+        column marks terminal transitions, whose one-off rewards must not
+        enter the persistent-reward bound)."""
+        n = min(self._size, max_n)
+        return self.reward[:n].copy(), self.discount[:n].copy()
 
     def add_batch(self, obs, action, reward, discount, next_obs) -> np.ndarray:
         """Insert B transitions; returns the slots written (for PER subclass)."""

@@ -813,8 +813,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # reinterpret the restored critic. Must happen before the first
             # dispatch: jit is lazy, so the rebuild costs no extra compile.
             source = device_replay if use_device_replay else replay
+            rewards, discounts = source.reward_sample()
             v_lo, v_hi = support_auto.initial_bounds(
-                source.reward_sample(), config.gamma, config.n_step
+                rewards, config.gamma, config.n_step, discounts=discounts
             )
             learner.set_value_bounds(v_lo, v_hi)
             print(

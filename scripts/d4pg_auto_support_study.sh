@@ -29,5 +29,10 @@ run() {  # run <tag> <extra flags...>
   grep -o "auto C51 support[^\"]*" "runs/r5_d4pg_auto_${tag}.out" | head -5
 }
 
-run lunar   --env_id=LunarLanderContinuous-v2 --num_actors=4
+# Historical: `run lunar` (runs/r5_d4pg_auto_lunar.jsonl) was captured
+# with the PRE-terminal-mask sizing rule, which oversized the support to
+# [-3731, 639] (vs the ±400 hand value). It is retired here — on current
+# code it would be config-identical to lunar_v2 and just burn a
+# duplicate run; the committed artifact is the comparison datapoint.
 run cheetah --env_id=HalfCheetah-v4 --num_actors=1
+run lunar_v2 --env_id=LunarLanderContinuous-v2 --num_actors=4

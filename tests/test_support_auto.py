@@ -55,6 +55,26 @@ class TestInitialBounds:
         assert v_min <= -100.0
         assert v_max >= 100.0
 
+    def test_terminal_rewards_excluded_from_persistent_bound(self):
+        # LunarLander warmup regression (measured, round 5): random-policy
+        # crashes put -100 terminals inside the 1st percentile, and the
+        # persistent bound multiplied them by the ~34-step horizon —
+        # support [-3731, 639] where the hand value was ±400. With the
+        # discount mask the terminals only enter via the raw extreme.
+        rng = np.random.default_rng(3)
+        n = 20_000
+        r = rng.normal(-0.5, 1.5, size=n)
+        d = np.full(n, 0.99**3)
+        term = rng.random(n) < 0.02  # crash every ~50 transitions
+        r[term] = -100.0
+        d[term] = 0.0
+        v_min, v_max = support_auto.initial_bounds(
+            r, gamma=0.99, n_step=3, discounts=d
+        )
+        assert v_min <= -100.0  # crash reward itself stays inside
+        assert v_min >= -1000.0  # but is not horizon-multiplied to -3700
+        assert v_max <= 500.0
+
     def test_nstep_rewards_use_effective_discount(self):
         # n-step rewards are ~n× larger but bootstrap through gamma^n; the
         # two effects cancel, so 1-step and 3-step sizing must agree to
@@ -64,6 +84,19 @@ class TestInitialBounds:
         lo1, _ = support_auto.initial_bounds(r1, gamma=0.99, n_step=1)
         lo3, _ = support_auto.initial_bounds(3.0 * r1, gamma=0.99, n_step=3)
         assert 0.5 < lo3 / lo1 < 2.0
+
+    def test_all_terminal_warmup_skips_horizon(self):
+        # Bandit-style env: every transition terminal, nothing bootstraps —
+        # true returns ARE the rewards, so the support must not be
+        # horizon-multiplied ~100x into one-atom resolution.
+        rng = np.random.default_rng(4)
+        r = rng.uniform(-1.0, 1.0, size=2000)
+        d = np.zeros(2000)
+        v_min, v_max = support_auto.initial_bounds(
+            r, gamma=0.99, n_step=1, discounts=d
+        )
+        assert -3.0 <= v_min <= -1.0
+        assert 1.0 <= v_max <= 3.0
 
     def test_degenerate_rewards_get_floor_width(self):
         v_min, v_max = support_auto.initial_bounds(
