@@ -38,20 +38,28 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-# Fraction of the half-range from the center beyond which mean_q counts as
-# "approaching an edge". 0.7 fires BEFORE projection clipping saturates the
-# edge atoms (mean_q can never exceed v_max, so waiting for equality would
-# be waiting forever).
-EDGE_FRACTION = 0.7
+# Edge-proximity threshold, in units of |mean_q| (NOT support width): the
+# high edge counts as "approached" when (v_max - mean_q) < PROXIMITY *
+# max(|mean_q|, MIN_HALF_WIDTH), and symmetrically for the low edge. Scaling
+# by mean_q instead of the span makes the trigger immune to an oversized
+# support: with a width-relative rule, a support accidentally sized
+# [-3731, 639] saw the PERFECTLY HEALTHY mean_q of -11.7 as "inside the top
+# 30% of the span" and expanded v_max to 5010 (measured, round-5 LunarLander
+# v1 run) — growing exactly the resolution problem it was meant to solve.
+# The MIN_HALF_WIDTH floor keeps a near-zero edge (Pendulum's v_max ~ 0)
+# expandable: mean_q -> 0 from below still closes within the floor. It
+# fires BEFORE projection clipping fully saturates the edge atoms (mean_q
+# can never exceed v_max, so waiting for equality would be waiting forever).
+PROXIMITY = 0.3
 # On expansion the approached edge moves to center ± GROWTH * half-range:
 # geometric growth => O(log) recompiles over any true range.
 GROWTH = 3.0
 # Learner steps to HOLD after an expansion before re-checking. The stretch
-# is affine and the logits are unchanged, so the reinterpreted mean_q sits
-# at EXACTLY the same fraction of the new half-range as before (the trigger
-# is scale-invariant): an immediate re-check would re-fire regardless of
-# need and cascade the support to infinity, one recompile per check. Only
-# SGD moves the fraction — TD targets pull the stretched predictions back
+# is affine and the logits are unchanged, so the reinterpreted mean_q lands
+# near the NEW edge again (stretched by the same factor as the support) and
+# an immediate re-check would re-fire regardless of need, cascading the
+# support toward infinity at one recompile per check. Only SGD moves
+# mean_q off the edge — TD targets pull the stretched predictions back
 # toward the true (unstretched) Q over O(hundreds) of steps — so the
 # controller must wait out that relearn horizon. Callers enforce this via
 # the steps_since_expansion argument below.
@@ -123,14 +131,14 @@ def maybe_expand(
     steps_since_expansion: Optional[int] = None,
 ) -> Optional[Tuple[float, float]]:
     """Edge-triggered geometric expansion. Returns new (v_min, v_max) when
-    mean_q has drifted past EDGE_FRACTION of the half-range toward either
-    edge, else None (no change — the caller skips the recompile).
+    mean_q has closed to within PROXIMITY * max(|mean_q|, MIN_HALF_WIDTH)
+    of either edge, else None (no change — the caller skips the recompile).
 
     steps_since_expansion: learner steps since the caller last applied an
     expansion (None = never). Checks inside COOLDOWN_STEPS are refused —
-    see the COOLDOWN_STEPS note: the trigger is invariant under its own
-    expansion, so without the hold every check after the first trigger
-    would re-fire and cascade."""
+    see the COOLDOWN_STEPS note: the affine stretch re-places the
+    reinterpreted mean_q near the new edge, so without the hold the check
+    right after an expansion would re-fire and cascade."""
     if (
         steps_since_expansion is not None
         and steps_since_expansion < COOLDOWN_STEPS
@@ -140,9 +148,10 @@ def maybe_expand(
         return None
     center = 0.5 * (v_min + v_max)
     half = 0.5 * (v_max - v_min)
-    if mean_q > center + EDGE_FRACTION * half:
+    near = PROXIMITY * max(abs(mean_q), MIN_HALF_WIDTH)
+    if v_max - mean_q < near:
         return v_min, center + GROWTH * half
-    if mean_q < center - EDGE_FRACTION * half:
+    if mean_q - v_min < near:
         return center - GROWTH * half, v_max
     return None
 

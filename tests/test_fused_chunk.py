@@ -40,7 +40,9 @@ def _assert_tree_close(a, b, rtol=2e-5, atol=1e-6):
     "hidden,scale,offset",
     [
         ((32, 32), 2.0, 0.0),
-        ((32, 24, 16), 1.5, 0.25),  # deeper nets + asymmetric action box
+        # Deeper nets + asymmetric action box: same oracle, second shape —
+        # slow tier keeps the fast tier's one-per-branch representative rule.
+        pytest.param((32, 24, 16), 1.5, 0.25, marks=pytest.mark.slow),
     ],
 )
 def test_fused_chunk_matches_scan(hidden, scale, offset):
@@ -76,7 +78,10 @@ def test_fused_chunk_c51_matches_scan():
     )
 
 
-@pytest.mark.parametrize("distributional", [False, True])
+@pytest.mark.parametrize(
+    "distributional",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+)
 def test_fused_chunk_bf16_matches_scan(distributional):
     """Mixed precision: the kernel's bf16-operand/f32-accumulate dots must
     track the scan path's (models/mlp._dense) within bf16 rounding — the
@@ -96,7 +101,10 @@ def test_fused_chunk_bf16_matches_scan(distributional):
     )
 
 
-@pytest.mark.parametrize("delay,noise", [(1, 0.0), (2, 0.2)])
+@pytest.mark.parametrize(
+    "delay,noise",
+    [pytest.param(1, 0.0, marks=pytest.mark.slow), (2, 0.2)],
+)
 def test_fused_chunk_td3_matches_scan(delay, noise):
     """TD3 in the kernel: twin members as separate rank-2 ref groups,
     min-over-ensemble targets, smoothing noise STREAMED from the scan
@@ -116,6 +124,7 @@ def test_fused_chunk_td3_matches_scan(delay, noise):
     )
 
 
+@pytest.mark.slow
 def test_fused_chunk_td3_step_offset_continuity():
     """The delayed-update schedule and the noise stream key off the GLOBAL
     step, so a chunk starting at an arbitrary step0 must keep matching the
@@ -285,7 +294,10 @@ def test_supported_gates():
         DDPGConfig(fused_chunk="Off")
 
 
-@pytest.mark.parametrize("autotune", [True, False])
+@pytest.mark.parametrize(
+    "autotune",
+    [True, pytest.param(False, marks=pytest.mark.slow)],
+)
 def test_fused_chunk_sac_matches_scan(autotune):
     """SAC in the kernel (round 4): Gaussian head split + tanh soft-clamp,
     reparameterized sampling from the scan path's exact fold_in stream
@@ -323,6 +335,7 @@ def test_fused_chunk_sac_bf16_matches_scan():
     )
 
 
+@pytest.mark.slow
 def test_fused_chunk_sac_step_offset_continuity():
     """SAC's sampling streams key off the GLOBAL step (fold_in(base,
     step)), so a second fused chunk starting at step0=K must keep matching

@@ -227,6 +227,7 @@ def test_ondevice_rejects_per_and_nstep():
         OnDeviceDDPG(_tiny_config(n_step=3))
 
 
+@pytest.mark.slow
 def test_ondevice_runs_all_families():
     """The fully-fused backend (env + replay + learner in one XLA program)
     must compose with every algorithm family: the TD3 lax.cond-delayed

@@ -35,11 +35,11 @@ def _run(tmp_path, tag: str) -> list:
         critic_hidden=(16, 16),
         n_step=2,
         batch_size=32,
-        replay_min_size=256,
-        total_env_steps=1500,
+        replay_min_size=192,
+        total_env_steps=1000,
         max_learn_ratio=1.0,
         max_ingest_ratio=1.0,
-        eval_every=600,
+        eval_every=400,
         log_path=str(log),
     )
     train_jax(config)

@@ -54,8 +54,17 @@ def _run_child(case: str, timeout: float = 600) -> dict:
 
 @pytest.fixture(scope="session")
 def tpu():
+    if os.environ.get("TPU_TIER", "") == "skip":
+        # Explicit bypass for dev/CI runs that know no chip is attached —
+        # skips without paying the probe at all.
+        pytest.skip("TPU tier bypassed (TPU_TIER=skip)")
     try:
-        probe = _run_child("probe", timeout=180)
+        # 90s is THE liveness bound (scripts/tpu_alive.py / the recovery
+        # runbook): covers a cold connect+compile (~30-40s observed) with
+        # margin, while a WEDGED tunnel costs the fast tier exactly one
+        # bounded probe instead of a long hang (a 180s probe was the fast
+        # tier's single biggest line item during the 2026-07 incident).
+        probe = _run_child("probe", timeout=90)
     except Exception as e:  # backend init failure == no usable TPU
         pytest.skip(f"no native TPU backend: {e}")
     if not probe.get("is_tpu"):
