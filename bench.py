@@ -41,6 +41,10 @@ import time
 import numpy as np
 
 OBS_DIM, ACT_DIM = 17, 6
+# Platforms that count as a real accelerator for BENCH_REQUIRE_TPU gates
+# (axon is this image's remote-TPU plugin name). Keep the probe gate and
+# phase_study's fallback check reading the same set.
+ACCEL_PLATFORMS = ("tpu", "axon")
 HIDDEN = (256, 256)
 BATCH = 64
 NATIVE_STEPS = 400
@@ -349,6 +353,20 @@ def phase_study() -> dict:
     import jax
 
     _assert_platform()
+    # The platform this phase ACTUALLY measured on — not the orchestrator
+    # probe's view, which can go stale if the tunnel flaps between probe
+    # and study (the runbook gates study-slice retirement on this field).
+    # Under BENCH_REQUIRE_TPU a silent CPU fallback must fail loudly here,
+    # not emit CPU numbers that look retireable.
+    measured_platform = jax.devices()[0].platform
+    if (
+        os.environ.get("BENCH_REQUIRE_TPU", "0") == "1"
+        and measured_platform not in ACCEL_PLATFORMS
+    ):
+        raise RuntimeError(
+            f"study phase initialized on {measured_platform!r} under "
+            "BENCH_REQUIRE_TPU=1 (silent accelerator fallback)"
+        )
     seconds = float(os.environ.get("BENCH_SECONDS", "6"))
     base = _config()
     grid = [
@@ -377,6 +395,13 @@ def phase_study() -> dict:
          base.replace(fused_chunk=m, sac=True))
         for m in ("auto", "off")
     ]
+    # BENCH_STUDY_FILTER=<prefix>[,<prefix>...] narrows the grid so one
+    # invocation fits inside a short tunnel-recovery window (~3 min
+    # observed 2026-07-31); the recovery runbook drains the grid as
+    # per-pair resumable stages instead of one 12-point monolith.
+    filt = [p for p in os.environ.get("BENCH_STUDY_FILTER", "").split(",") if p]
+    if filt:
+        grid = [kv for kv in grid if any(kv[0].startswith(p) for p in filt)]
     points = {}
     for key, config in grid:
         # Per-point isolation: one failing point (e.g. the kernel at a
@@ -392,7 +417,7 @@ def phase_study() -> dict:
             }
         except Exception as e:
             points[key] = {"error": repr(e)[:300]}
-    return {"study": points}
+    return {"study": points, "study_platform": measured_platform}
 
 
 _PHASES = {
@@ -509,11 +534,20 @@ def main() -> int:
     accel_errors = []
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
     require_tpu = os.environ.get("BENCH_REQUIRE_TPU", "0") == "1"
+    # BENCH_STUDY_ONLY=1 (with BENCH_STUDY=1): probe, then go STRAIGHT to
+    # the study phase — no headline jax capture, no native baseline. A
+    # study slice under the recovery runbook re-measures nothing the
+    # headline bench already captured, and the saved ~2 min is the
+    # difference between fitting a ~3-min tunnel window or not.
+    study_only = (
+        os.environ.get("BENCH_STUDY", "0") == "1"
+        and os.environ.get("BENCH_STUDY_ONLY", "0") == "1"
+    )
     for attempt in range(3):
         note(f"probe attempt {attempt + 1} (timeout {probe_timeout:.0f}s)")
         probe, err = _run_phase("probe", accel_env, timeout=probe_timeout)
         if probe and probe.get("ok"):
-            if require_tpu and probe.get("platform") not in ("tpu", "axon"):
+            if require_tpu and probe.get("platform") not in ACCEL_PLATFORMS:
                 # With JAX_PLATFORMS unset, a failed TPU plugin init falls
                 # back to CPU SILENTLY — the probe would "pass" with
                 # platform cpu and the 900s jax phase would burn a recovery
@@ -537,7 +571,11 @@ def main() -> int:
         note(f"probe failed: {str(err)[:200]}")
         if attempt < 2:
             time.sleep(5 * (attempt + 1))
-    if probe:
+    if probe and study_only:
+        result["platform"] = probe["platform"]
+        result["device_kind"] = probe["device_kind"]
+        result["n_devices"] = probe["n_devices"]
+    elif probe:
         note("accelerator measurement phase")
         accel, err = _run_phase("jax", accel_env, timeout=900)
         if not accel:
@@ -549,8 +587,9 @@ def main() -> int:
             # but only where the kernel could have been active at all
             # (single accelerator device; multi-device meshes and CPU never
             # activate it, so the rerun would fail identically).
-            if probe.get("n_devices") == 1 and probe.get("platform") in (
-                "tpu", "axon"
+            if (
+                probe.get("n_devices") == 1
+                and probe.get("platform") in ACCEL_PLATFORMS
             ):
                 accel, err = _run_phase(
                     "jax", {**accel_env, "BENCH_FUSED": "off"}, timeout=900
@@ -559,16 +598,22 @@ def main() -> int:
                     accel_errors.append(err)
     # CPU-native baseline — the vs_baseline denominator. Tunnel-independent
     # (JAX_PLATFORMS=cpu), so it runs AFTER the time-critical accelerator
-    # capture and cannot wedge it.
-    note("native baseline phase")
-    native, err = _run_phase("native", {"JAX_PLATFORMS": "cpu"}, timeout=600)
-    if native:
-        result["baseline_native_cpu"] = round(native["native_rate"], 1)
-        note(f"native baseline: {native['native_rate']:.1f}/s")
-    else:
-        errors.append(err)
+    # capture and cannot wedge it. Skipped in study-only mode: the slice's
+    # evidence is the study points, not a baseline ratio.
+    native = None
+    if not study_only:
+        note("native baseline phase")
+        native, err = _run_phase("native", {"JAX_PLATFORMS": "cpu"}, timeout=600)
+        if native:
+            result["baseline_native_cpu"] = round(native["native_rate"], 1)
+            note(f"native baseline: {native['native_rate']:.1f}/s")
+        else:
+            errors.append(err)
 
-    if accel is None and forced != "cpu":
+    if study_only and probe is None:
+        result["tpu_error"] = "; ".join(accel_errors[-3:])
+        note("probe dead in BENCH_STUDY_ONLY mode: nothing to run")
+    if accel is None and forced != "cpu" and not study_only:
         result["tpu_error"] = "; ".join(accel_errors[-3:])
         if require_tpu:
             # Runbook mode: the caller only wants the TPU capture (it
@@ -608,19 +653,28 @@ def main() -> int:
     # Study only makes sense against a healthy accelerator — after a CPU
     # fallback (tpu_error set) each grid point would just re-fail or hang
     # against the dead platform.
+    study = None
     if (
         os.environ.get("BENCH_STUDY", "0") == "1"
-        and accel
+        and (accel or (study_only and probe))
         and "tpu_error" not in result
     ):
         note("kernel study phase")
-        study, err = _run_phase("study", accel_env, timeout=1800)
+        # A filtered slice is one fused/scan pair (~2 min incl. compiles);
+        # 480s keeps the runbook's 900s outer stage timeout strictly
+        # dominant over worst-case probes (3x90s+15s) + this phase.
+        study_timeout = 480 if os.environ.get("BENCH_STUDY_FILTER") else 1800
+        study, err = _run_phase("study", accel_env, timeout=study_timeout)
         if study:
             result.update(study)
         else:
             errors.append(err)
 
-    if os.environ.get("BENCH_SCALING", "1") != "0":
+    # study_only also implies no scaling phase: without this, a hand-run
+    # slice missing BENCH_SCALING=0 would burn up to 900s of CPU scaling
+    # AFTER the study points are measured but BEFORE the JSON is printed —
+    # under the runbook's 900s outer timeout the evidence would be lost.
+    if os.environ.get("BENCH_SCALING", "1") != "0" and not study_only:
         note("virtual-device scaling phase")
         scaling, err = _run_phase(
             "scaling",
@@ -639,6 +693,8 @@ def main() -> int:
     if (errors or accel_errors) and "tpu_error" not in result:
         result["errors"] = (accel_errors + errors)[-3:]
     print(json.dumps(result), flush=True)
+    if study_only:
+        return 0 if study else 1
     return 0 if native else 1
 
 

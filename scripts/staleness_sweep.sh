@@ -26,10 +26,21 @@ run() { # name, extra flags...
     FAILED=$((FAILED + 1))   # keep sweeping — later points still have value
   fi
 }
-run ratio1  --max_learn_ratio=1 --max_ingest_ratio=1
-run ratio4  --max_learn_ratio=4
-run ratio16 --max_learn_ratio=16
-run free
+# Optional row selector ($1): run ONE row so the recovery runbook can
+# drain the sweep as per-row resumable stages across short tunnel
+# windows (each row is ~7 min; observed windows can be ~3 min, so rows
+# land only in long windows — but each landed row is durable evidence).
+ONLY="${1:-}"
+case "$ONLY" in
+  ""|ratio1|ratio4|ratio16|free) ;;
+  *) echo "unknown sweep row: $ONLY (rows: ratio1 ratio4 ratio16 free)" >&2
+     exit 2 ;;  # a typo'd selector must NOT fall through to SWEEP_DONE
+esac
+want() { [ -z "$ONLY" ] || [ "$ONLY" = "$1" ]; }
+want ratio1  && run ratio1  --max_learn_ratio=1 --max_ingest_ratio=1
+want ratio4  && run ratio4  --max_learn_ratio=4
+want ratio16 && run ratio16 --max_learn_ratio=16
+want free    && run free
 if [ "$FAILED" -gt 0 ]; then
   echo "SWEEP_INCOMPLETE: $FAILED run(s) failed" >&2
   exit 1

@@ -16,6 +16,35 @@
 LOG="${1:-runs/r4_tpu_probe.log}"
 INTERVAL="${2:-60}"
 RUN_ON_RECOVERY="${RUN_ON_RECOVERY:-0}"
+
+# This host has ONE core. Background CPU studies (nice'd or not) slow
+# the runbook's host-side XLA compiles enough to push a ~2-min stage
+# past a ~3-min tunnel window, so niceness alone is not sufficient:
+# SIGSTOP every registered CPU job for the duration of a recovery
+# window, SIGCONT afterwards. Jobs register by appending their PGID
+# (launch under setsid) to runs/cpu_jobs.pids.
+PIDFILE="runs/cpu_jobs.pids"
+cpu_jobs() {  # cpu_jobs <signal>
+  # Guard against PGID recycling: only signal a group whose leader still
+  # looks like one of OUR jobs (repo scripts / package trainers). A
+  # stale entry whose PGID the kernel reused for something unrelated
+  # must not get frozen for a whole runbook invocation.
+  [ -f "$PIDFILE" ] || return 0
+  while read -r pg; do
+    [ -n "$pg" ] || continue
+    ps -o args= -p "$pg" 2>/dev/null \
+      | grep -q 'scripts/\|distributed_ddpg_tpu' || continue
+    kill "-$1" "-$pg" 2>/dev/null
+  done < "$PIDFILE"
+}
+# If this loop is killed mid-runbook, the registered jobs must not stay
+# frozen forever — CONT on any exit path. (CONT on a running job is a
+# harmless no-op.) INT/TERM must EXIT after the handler — a bare-CONT
+# trap would swallow the signal and resume the while-true loop, leaving
+# kill -9 (which skips traps, and so the CONT) as the only way out.
+trap 'cpu_jobs CONT' EXIT
+trap 'exit 129' INT
+trap 'exit 143' TERM
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   out=$(timeout 90 python "$(dirname "$0")/tpu_alive.py" 2>&1)
@@ -25,12 +54,19 @@ while true; do
     if [ "$RUN_ON_RECOVERY" = "1" ]; then
       RUNBOOK="$(dirname "$0")/tpu_recovery_runbook.sh"
       if [ -f "$RUNBOOK" ]; then
-        echo "$ts launching recovery runbook" >> "$LOG"
-        if bash "$RUNBOOK" >> "$LOG" 2>&1; then
+        echo "$ts launching recovery runbook (STOPping cpu jobs)" >> "$LOG"
+        cpu_jobs STOP
+        # Seed the runbook's liveness freshness with THIS probe's success
+        # time so its first stage doesn't re-pay a ~30-40s cold-connect
+        # probe for liveness proven one second ago.
+        rb_rc=0
+        TPU_LAST_ALIVE=$(date -u +%s) bash "$RUNBOOK" >> "$LOG" 2>&1 || rb_rc=$?
+        cpu_jobs CONT
+        if [ "$rb_rc" -eq 0 ]; then
           echo "$ts queue fully drained — probe loop exiting" >> "$LOG"
           exit 0
         fi
-        echo "$ts runbook returned with queue incomplete; rewatching" >> "$LOG"
+        echo "$ts runbook returned with queue incomplete; rewatching (cpu jobs CONTinued)" >> "$LOG"
       else
         echo "$ts RUNBOOK_MISSING $RUNBOOK — evidence queue NOT run" >> "$LOG"
         exit 0

@@ -23,24 +23,26 @@
 # Exit 0 only when every stage is retired; the probe loop keeps watching
 # for windows until then.
 #
-# Stages:
-#   bench    (~4 min) clean single-run headline capture, TPU-first
-#            ordering inside bench.py (VERDICT r3 Missing #1).
-#   smoke    (~2 min) native-Mosaic compile of the DDPG kernel (the
-#            round-2 failure class). Ran green 03:21Z 2026-07-31.
-#   tputests (~15 min) full tpu tier: C51/bf16/TD3/SAC kernel branches
-#            have only ever compiled in interpret mode.
-#   study    (~10 min) kernel-vs-scan grid incl. d4pg/bf16/td3/sac + MFU.
-#   chunk16/chunk32 (~8 min each) chunk-length experiment.
-#   sweep    (~30 min) staleness sweep, all four EVIDENCE §4 rows.
-#   ladder23 (~20 min) rungs 2,3 TPU re-records with platform field.
+# Stages (round-5 shape — sized to the ~3-min windows observed
+# 2026-07-31; see the comment above the stage list):
+#   bench     (~2 min) clean single-run headline capture. DONE 19:05Z.
+#   smoke     (~2 min) native-Mosaic DDPG kernel parity. DONE 03:21Z.
+#   tpu_*     (~2 min each) one tpu-tier child case per stage:
+#             c51/bf16/td3/sac kernel branches + device-replay dispatch.
+#   study_*   (~2-3 min each) one kernel-vs-scan grid pair per stage
+#             via BENCH_STUDY_FILTER.
+#   chunk16/chunk32 (~2 min each) chunk-length experiment.
+#   sweep4/sweep16/sweepfree (~7 min each) staleness rows (ratio1
+#             landed round 3) — long-window-only.
+#   ladder23  (~20 min) rungs 2,3 TPU re-records — long-window-only.
+#   tputests  (~15 min) consolidating full-pytest tpu tier, last.
 #
 # Outer stage timeouts: derivation lives next to the stage list below.
 set -u
 cd "$(dirname "$0")/.."
 DONE_DIR="runs/r4_queue_done"
 mkdir -p "$DONE_DIR"
-STAGES="bench smoke tputests study chunk16 chunk32 sweep ladder23"
+STAGES="bench smoke tpu_c51 tpu_bf16 tpu_td3 tpu_sac tpu_sample study_b64 study_b256 study_b1k study_d4pg study_bf16 study_td3 study_sac chunk16 chunk32 sweep4 sweep16 sweepfree ladder23 tputests"
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 SUMMARY="runs/r4_recovery_${STAMP}_summary.log"
 note() { echo "$(date -u +%H:%M:%SZ) $*" | tee -a "$SUMMARY"; }
@@ -49,8 +51,37 @@ note() { echo "$(date -u +%H:%M:%SZ) $*" | tee -a "$SUMMARY"; }
 # liveness definition and 90s is THE bound; a tighter bound here would
 # make a slow-but-alive tunnel pass the loop's probe and fail every
 # stage's, spinning no-op runbook invocations.
+# Dead-probe short-circuit: with 21 stages, a tunnel that dies mid-queue
+# would otherwise burn a serial 90s probe per remaining stage (~30 min of
+# no-op probing — during which the probe loop holds every CPU job
+# SIGSTOPped). The first dead probe latches TUNNEL_DEAD; the runbook then
+# falls through instantly and returns to the probe loop, which owns the
+# re-watch cadence. A flap back mid-queue is deliberately NOT waited for
+# here — the loop re-invokes on the next RECOVERED probe.
+TUNNEL_DEAD=0
+# The probe loop seeds TPU_LAST_ALIVE with its own just-succeeded
+# RECOVERED probe so stage 1 doesn't re-pay a cold-connect probe.
+LAST_ALIVE="${TPU_LAST_ALIVE:-0}"
 alive() {
-  timeout 90 python scripts/tpu_alive.py >/dev/null 2>&1
+  [ "$TUNNEL_DEAD" = "1" ] && return 1
+  if timeout 90 python scripts/tpu_alive.py >/dev/null 2>&1; then
+    LAST_ALIVE=$(date -u +%s)
+    return 0
+  fi
+  TUNNEL_DEAD=1
+  return 1
+}
+
+# Stage PRE-checks use this: a stage that just retired with evidence
+# proves the tunnel was alive seconds ago, so the next stage must not
+# burn a ~30-40s cold-connect probe re-proving it (across a 12-stage
+# healthy-window drain that's 2-3 whole windows of probing). Strike
+# attribution in count_failure keeps calling the REAL alive() — after a
+# failure, freshness is exactly what we cannot assume.
+alive_fresh() {
+  [ "$TUNNEL_DEAD" = "1" ] && return 1
+  [ $(( $(date -u +%s) - LAST_ALIVE )) -lt 45 ] && return 0
+  alive
 }
 
 count_failure() {  # count_failure <name> <rc>
@@ -84,13 +115,20 @@ check_evidence() {  # check_evidence <log> <wantspec>
   # both '"study"' AND the platform:"tpu" pattern; grepping '"study"'
   # alone would let a silent CPU fallback retire the stage with CPU
   # numbers).
+  # A pattern starting with '!' is NEGATIVE: it must NOT appear. Needed
+  # for pytest stages, where "1 failed, 5 passed" exits 1 yet contains
+  # " passed" — without the negation the evidence-despite-rc path would
+  # retire the stage over real failures.
   local log=$1 spec=$2 pat rest
   [ "$spec" = "-" ] && return 0
   rest=$spec
   while [ -n "$rest" ]; do
     pat=${rest%%'%%'*}
     if [ "$pat" = "$rest" ]; then rest=""; else rest=${rest#*%%}; fi
-    grep -q "$pat" "$log" || return 1
+    case "$pat" in
+      !*) grep -q "${pat#!}" "$log" && return 1 ;;
+      *)  grep -q "$pat" "$log" || return 1 ;;
+    esac
   done
   return 0
 }
@@ -102,7 +140,7 @@ stage() {  # stage <name> <timeout_s> <evidence_spec|-> <cmd...>
     note "DONE-SKIP $name"
     return 0
   fi
-  if ! alive; then
+  if ! alive_fresh; then
     note "SKIP $name (tunnel not alive)"
     return 1
   fi
@@ -115,6 +153,7 @@ stage() {  # stage <name> <timeout_s> <evidence_spec|-> <cmd...>
       return 1
     fi
     note "OK $name"
+    LAST_ALIVE=$(date -u +%s)  # evidence == the tunnel was just alive
     date -u +%Y-%m-%dT%H:%M:%SZ > "$DONE_DIR/$name.done"
   else
     local rc=$?
@@ -124,6 +163,7 @@ stage() {  # stage <name> <timeout_s> <evidence_spec|-> <cmd...>
     # regardless of exit code.
     if [ "$gated" = "1" ] && check_evidence "$log" "$want"; then
       note "OK $name (rc=$rc but required evidence captured — retired)"
+      LAST_ALIVE=$(date -u +%s)
       date -u +%Y-%m-%dT%H:%M:%SZ > "$DONE_DIR/$name.done"
       return 0
     fi
@@ -134,19 +174,65 @@ stage() {  # stage <name> <timeout_s> <evidence_spec|-> <cmd...>
 TPU='"platform": "\(tpu\|axon\)"'
 note "recovery runbook start (markers: $(ls "$DONE_DIR" 2>/dev/null | tr '\n' ' '))"
 # Outer timeouts strictly dominate bench.py's internal worst case under
-# BENCH_REQUIRE_TPU=1 with BENCH_PROBE_TIMEOUT pinned to 90 below
-# (3x90s probes + 15s sleeps + 900s jax + 900s fused-off retry + 600s
-# native = 2685s before interpreter/phase overhead): 3000 for
-# bench/chunk, 4800 for study (its extra grid grant), so a legitimately
-# progressing run is never killed at rc=124 with a silently burnt window.
-stage bench    3000 "$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_SECONDS=5 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
-stage smoke    300  -      python tests/tpu_child.py fused_parity
-stage tputests 1500 -      python -m pytest tests/test_tpu.py -q
-stage study    4800 '"study"'"%%$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_STUDY=1 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
-stage chunk16  3000 "$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_CHUNK=1600 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
-stage chunk32  3000 "$TPU" env BENCH_PROBE_TIMEOUT=90 BENCH_CHUNK=3200 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1 python bench.py
-stage sweep    2700 -      bash scripts/staleness_sweep.sh
-stage ladder23 2400 -      python -m distributed_ddpg_tpu.ladder --rungs=2,3 --log_dir=runs
+# BENCH_REQUIRE_TPU=1 with BENCH_PROBE_TIMEOUT pinned to 90 below:
+#   bench/chunk: 3x90s probes + 15s sleeps + 900s jax + 900s fused-off
+#     retry + 600s native = 2685s before interpreter overhead -> 3000.
+#   study_* (BENCH_STUDY_ONLY slices): 3x90s probes + 15s sleeps + 480s
+#     study-phase cap (bench.py pins it when BENCH_STUDY_FILTER is set;
+#     one fused/scan pair measures in ~2 min) = 765s -> 900. A
+#     multi-prefix filter does NOT get more time — add a stage instead.
+# So a legitimately progressing run is never killed at rc=124 with a
+# silently burnt window.
+# Round-5 restructure: the 19:03Z window lasted ~3 min — long enough for
+# bench, then the monolithic 15-min tputests burned 25 min of wedge
+# collateral (two 600s child timeouts + outer kill) without retiring
+# anything. Every stage below is sized to fit a ~3-min window where the
+# work allows it: the tpu tier runs one child case per stage (~2 min
+# each, evidence = the case's own '"ok": true' JSON), the study grid
+# drains as per-pair BENCH_STUDY_FILTER slices, the staleness sweep as
+# per-row invocations (rows are ~7 min — long-window-only, but each
+# landed row is durable). ratio1 landed in round 3; ladder23 and the
+# consolidating full-pytest pass run last, long-window-only.
+OK='"ok": true'
+BENV="BENCH_PROBE_TIMEOUT=90 BENCH_SECONDS=5 BENCH_SCALING=0 BENCH_REQUIRE_TPU=1"
+stage bench      3000 "$TPU" env $BENV python bench.py
+stage smoke      300  "$OK" python tests/tpu_child.py fused_parity
+stage tpu_c51    420  "$OK" python tests/tpu_child.py fused_parity_c51
+stage tpu_bf16   420  "$OK" python tests/tpu_child.py fused_parity_bf16
+stage tpu_td3    420  "$OK" python tests/tpu_child.py fused_parity_td3
+stage tpu_sac    420  "$OK" python tests/tpu_child.py fused_parity_sac
+stage tpu_sample 420  "$OK"'%%"fused_chunk_active": true' python tests/tpu_child.py sample_chunk
+# Study slices: BENCH_STUDY_ONLY skips the headline jax + native phases
+# (the headline bench already captured them), and the evidence token is
+# the slice's own MEASURED point — '"<key>": {"grad_steps_per_sec"' —
+# not the key alone: phase_study keeps a key with {"error": ...} on a
+# per-point exception, and platform:"tpu" in study-only mode comes from
+# the probe, so key-presence + platform would retire an all-error slice.
+# The platform token for study slices is study_platform — the platform
+# the study phase ITSELF initialized on — not the orchestrator-level
+# "platform" field, which in study-only mode is copied from a probe that
+# can go stale if the tunnel flaps between probe and study.
+SENV="$BENV BENCH_STUDY=1 BENCH_STUDY_ONLY=1"
+STPU='"study_platform": "\(tpu\|axon\)"'
+pair() { printf '"%s_fused": {"grad_steps_per_sec"%%%%"%s_scan": {"grad_steps_per_sec"' "$1" "$1"; }
+stage study_b64  900 "$(pair b64)%%$STPU"   env $SENV BENCH_STUDY_FILTER=b64_ python bench.py
+stage study_b256 900 "$(pair b256)%%$STPU"  env $SENV BENCH_STUDY_FILTER=b256_ python bench.py
+stage study_b1k  900 "$(pair b1024)%%$STPU" env $SENV BENCH_STUDY_FILTER=b1024_ python bench.py
+stage study_d4pg 900 "$(pair d4pg)%%$STPU"  env $SENV BENCH_STUDY_FILTER=d4pg python bench.py
+stage study_bf16 900 "$(pair bf16)%%$STPU"  env $SENV BENCH_STUDY_FILTER=bf16 python bench.py
+stage study_td3  900 "$(pair td3)%%$STPU"   env $SENV BENCH_STUDY_FILTER=td3 python bench.py
+stage study_sac  900 "$(pair sac)%%$STPU"   env $SENV BENCH_STUDY_FILTER=sac python bench.py
+stage chunk16    3000 "$TPU" env $BENV BENCH_CHUNK=1600 python bench.py
+stage chunk32    3000 "$TPU" env $BENV BENCH_CHUNK=3200 python bench.py
+stage sweep4     1200 'SWEEP_DONE' bash scripts/staleness_sweep.sh ratio4
+stage sweep16    1200 'SWEEP_DONE' bash scripts/staleness_sweep.sh ratio16
+stage sweepfree  1200 'SWEEP_DONE' bash scripts/staleness_sweep.sh free
+# ladder23 must show the FINAL rung's record measured on the chip;
+# tputests must show actual passes — an all-skip pytest run exits 0 (the
+# tpu fixture skips in seconds when the tunnel flapped after the
+# alive_fresh pre-check), and that must not retire the stage.
+stage ladder23   2400 '"rung": 3'"%%$TPU" python -m distributed_ddpg_tpu.ladder --rungs=2,3 --log_dir=runs
+stage tputests   1500 ' passed%%! failed%%! error' python -m pytest tests/test_tpu.py -q
 note "recovery runbook done (markers: $(ls "$DONE_DIR" 2>/dev/null | tr '\n' ' '))"
 for s in $STAGES; do
   [ -f "$DONE_DIR/$s.done" ] || [ -f "$DONE_DIR/$s.gave_up" ] || exit 1

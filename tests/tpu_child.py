@@ -86,10 +86,16 @@ def _sample_chunk() -> dict:
     import jax
 
     from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.ops import fused_chunk
     from distributed_ddpg_tpu.parallel.learner import ShardedLearner
     from distributed_ddpg_tpu.parallel.mesh import make_mesh
     from distributed_ddpg_tpu.replay.device import DeviceReplay
 
+    # Unlike the parity cases this one would run happily on CPU (fused
+    # 'auto' just falls back to scan) — so a silent CPU fallback would
+    # print ok:true and retire the runbook stage without ever touching
+    # the chip. Assert native like every other case.
+    assert fused_chunk.runs_native(), "sample_chunk needs a native TPU backend"
     cfg = DDPGConfig(
         actor_hidden=(256, 256), critic_hidden=(256, 256), batch_size=B
     )
