@@ -32,7 +32,9 @@ def _setup(num_actors=2, **kw):
     return cfg, spec, state
 
 
-@pytest.mark.parametrize("mode", ["boot", "midrun"])
+@pytest.mark.parametrize(
+    "mode", ["boot", pytest.param("midrun", marks=pytest.mark.slow)]
+)
 def test_workers_exit_when_pool_dies_hard(mode):
     """Orphan guard (worker.py): a pool process that dies WITHOUT stop() —
     SIGKILL, or the stall watchdog's os._exit — must not leave workers

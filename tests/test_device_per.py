@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.parallel.learner import ShardedLearner
 from distributed_ddpg_tpu.parallel.mesh import make_mesh
@@ -65,6 +67,7 @@ def test_device_per_insert_stamps_max_priority():
     np.testing.assert_allclose(prios[128:], 0.0)  # empty slots zero-mass
 
 
+@pytest.mark.slow
 def test_run_sample_chunk_per_updates_priorities():
     cfg = DDPGConfig(
         actor_hidden=(16, 16), critic_hidden=(16, 16), batch_size=16,
@@ -127,6 +130,7 @@ def test_device_per_checkpoint_roundtrip(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_fused_per_matches_scan_per():
     """PER x megakernel (round 4): with fused_chunk='on' the PER chunk runs
     the kernel (draw + priority scatter stay XLA ops, IS weights ride the

@@ -77,6 +77,7 @@ def test_fused_sampling_chunk():
     assert float(out2.metrics["critic_loss"]) != float(out.metrics["critic_loss"])
 
 
+@pytest.mark.slow
 def test_sample_chunk_matches_manual_steps():
     """The pre-gathered sample chunk must equal K plain steps over the same
     indices: replicate the chunk's key-split + randint sampling, gather on

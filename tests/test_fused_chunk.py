@@ -101,9 +101,16 @@ def test_fused_chunk_bf16_matches_scan(distributional):
     )
 
 
+# Both params slow since round 5: the delay=2 leg was the fast tier's
+# second-biggest line item (63s interpret-mode compile+run); the TD3
+# kernel branch keeps a fast-feedback guard via the scan-path TD3 tests
+# and a HARDWARE guard via the runbook's tpu_td3 stage.
 @pytest.mark.parametrize(
     "delay,noise",
-    [pytest.param(1, 0.0, marks=pytest.mark.slow), (2, 0.2)],
+    [
+        pytest.param(1, 0.0, marks=pytest.mark.slow),
+        pytest.param(2, 0.2, marks=pytest.mark.slow),
+    ],
 )
 def test_fused_chunk_td3_matches_scan(delay, noise):
     """TD3 in the kernel: twin members as separate rank-2 ref groups,
@@ -164,6 +171,7 @@ def test_fused_chunk_td3_step_offset_continuity():
     assert int(s_on.critic_opt.count) == 9
 
 
+@pytest.mark.slow
 def test_sharded_learner_fused_path_matches_scan_path():
     """On a 1-device mesh, fused_chunk='on' must reproduce fused_chunk='off'
     through the public run_sample_chunk API: both draw the same (K, B) index
@@ -296,7 +304,10 @@ def test_supported_gates():
 
 @pytest.mark.parametrize(
     "autotune",
-    [True, pytest.param(False, marks=pytest.mark.slow)],
+    [
+        pytest.param(True, marks=pytest.mark.slow),
+        pytest.param(False, marks=pytest.mark.slow),
+    ],
 )
 def test_fused_chunk_sac_matches_scan(autotune):
     """SAC in the kernel (round 4): Gaussian head split + tanh soft-clamp,
@@ -319,6 +330,7 @@ def test_fused_chunk_sac_matches_scan(autotune):
     )
 
 
+@pytest.mark.slow
 def test_fused_chunk_sac_bf16_matches_scan():
     """SAC x mixed precision: bf16 dots with f32 accumulation on both the
     Gaussian head and the twin critics, bf16-level tolerances."""

@@ -77,6 +77,7 @@ def test_fused_mesh_activates_and_runs_on_data_mesh():
     assert np.isfinite(float(out2.metrics["critic_loss"]))
 
 
+@pytest.mark.slow
 def test_fused_mesh_exact_parity_with_local_sgd_reference():
     """The fused-mesh chunk must BE chunk-boundary-averaged local SGD: per
     device d, draws come from fold_in(split(key)[1], d); each device runs

@@ -47,6 +47,7 @@ def test_pendulum_solves():
     assert ret > -250.0, f"Pendulum not solved: eval return {ret}"
 
 
+@pytest.mark.slow
 def test_pendulum_short_run_improves():
     """Cheap CI proxy: 10k steps must clearly beat a random policy
     (random evals around -1200..-1500; trained-10k runs land near -780)."""

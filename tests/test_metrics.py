@@ -4,6 +4,8 @@ the TensorBoard parity sink."""
 import json
 import os
 
+import pytest
+
 from distributed_ddpg_tpu.metrics import MetricsLogger, Timer
 
 
@@ -20,6 +22,7 @@ def test_jsonl_records(tmp_path):
     assert recs[1]["step"] == 20
 
 
+@pytest.mark.slow
 def test_tensorboard_sink(tmp_path):
     tb_dir = tmp_path / "tb"
     log = MetricsLogger(echo=False, tb_dir=str(tb_dir))

@@ -171,7 +171,10 @@ def test_prefetcher_surfaces_worker_exception_promptly():
     pf.stop()
 
 
-@pytest.mark.parametrize("scaled,want_batch", [(True, 4 * B), (False, B)])
+@pytest.mark.parametrize(
+    "scaled,want_batch",
+    [pytest.param(True, 4 * B, marks=pytest.mark.slow), (False, B)],
+)
 def test_scale_batch_with_data(scaled, want_batch):
     """Per-device batch semantics (config.scale_batch_with_data): on a
     4-device data mesh the sampling paths draw batch_size rows PER DEVICE

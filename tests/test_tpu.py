@@ -15,7 +15,12 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.tpu
+# Also `slow`: without a TPU attached these skip in seconds, but against
+# a WEDGED tunnel (plugin present, compute hung — the 2026-07-31 flap
+# pattern) the session probe fixture costs its full 90s bound, which is
+# the fast tier's single biggest line item. The recovery runbook invokes
+# this file explicitly (no -m filter), so the tpu tier still runs there.
+pytestmark = [pytest.mark.tpu, pytest.mark.slow]
 
 CHILD = os.path.join(os.path.dirname(__file__), "tpu_child.py")
 
