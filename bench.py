@@ -615,6 +615,38 @@ def main() -> int:
         note("probe dead in BENCH_STUDY_ONLY mode: nothing to run")
     if accel is None and forced != "cpu" and not study_only:
         result["tpu_error"] = "; ".join(accel_errors[-3:])
+        # The tunnel flaps for hours at a stretch (runs/r*_tpu_probe.log);
+        # when THIS run can't reach the chip, point at the newest committed
+        # single-run TPU capture so the emitted JSON carries the provenance
+        # trail instead of only a CPU number. Clearly labeled as stale —
+        # it is a pointer, not a measurement of this run.
+        try:
+            import glob
+            import re
+
+            here = os.path.dirname(os.path.abspath(__file__))
+
+            def _round_no(p):
+                m = re.search(r"r(\d+)_bench_tpu\.json$", p)
+                return int(m.group(1)) if m else -1
+
+            # Sort by the ROUND NUMBER in the filename, not mtime — a
+            # fresh checkout gives every artifact the same mtime.
+            caps = sorted(glob.glob(os.path.join(here, "runs/r*_bench_tpu.json")),
+                          key=_round_no)
+            if caps:
+                with open(caps[-1]) as f:
+                    cap = json.load(f)
+                if cap.get("platform") in ACCEL_PLATFORMS:
+                    result["last_known_tpu_capture"] = {
+                        "file": os.path.relpath(caps[-1], here),
+                        "value": cap.get("value"),
+                        "vs_baseline": cap.get("vs_baseline"),
+                        "note": "prior committed single-run TPU capture; "
+                                "NOT measured by this invocation",
+                    }
+        except Exception:
+            pass  # the pointer is best-effort; never break the emission
         if require_tpu:
             # Runbook mode: the caller only wants the TPU capture (it
             # gates its completion marker on platform:"tpu") — a CPU
