@@ -122,10 +122,8 @@ class DDPGAgent:
             # has happened yet, so this costs nothing extra. After this the
             # config carries concrete bounds and the branch never re-enters.
             # Running expansion: the SupportController check further down.
-            rewards, discounts = self.replay.reward_sample()
-            v_lo, v_hi = support_auto.initial_bounds(
-                rewards, self.config.gamma, self.config.n_step,
-                discounts=discounts,
+            v_lo, v_hi = support_auto.replay_data_bounds(
+                self.replay, self.config.gamma, self.config.n_step
             )
             self._set_value_bounds(v_lo, v_hi)
         sample = self.replay.sample(self.config.batch_size)
@@ -134,13 +132,26 @@ class DDPGAgent:
         out: StepOutput = self._step_fn(self.state, batch)
         self.state = out.state
         self._learn_steps += 1
+        support_metrics = {}
         if self._support_auto_active and self._learn_steps % 50 == 0:
+            # Corroborated against the replay's CURRENT rewards — a
+            # diverging mean_q must not drag the support up
+            # (support_auto docstring, seed-1 incident).
             grown = self._support_controller.check(
                 self.config.v_min, self.config.v_max,
                 float(out.metrics["mean_q"]), self._learn_steps,
+                data_bounds_fn=lambda: support_auto.replay_data_bounds(
+                    self.replay, self.config.gamma, self.config.n_step
+                ),
             )
             if grown is not None:
                 self._set_value_bounds(*grown)
+        if self._support_auto_active:
+            # Same observability as the train_jax path: the refusal count
+            # is the diverging-critic signature.
+            support_metrics = dict(
+                support_refusals=self._support_controller.refusals
+            )
         if self.config.prioritized:
             # The only extra device->host transfer PER costs (uniform replay
             # skips it entirely — update_priorities would be a no-op).
@@ -150,7 +161,10 @@ class DDPGAgent:
                 self.config.per_beta
                 + frac * (self.config.per_beta_final - self.config.per_beta)
             )
-        return {k: float(v) for k, v in jax.device_get(out.metrics).items()}
+        return {
+            **{k: float(v) for k, v in jax.device_get(out.metrics).items()},
+            **support_metrics,
+        }
 
     def _expected_learn_steps(self) -> int:
         """Learner steps this run will take — the PER beta annealing horizon

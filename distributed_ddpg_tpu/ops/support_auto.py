@@ -22,10 +22,21 @@ knob with two rules:
    trained Q reaches 600+, which is exactly how the ±150 default saturated).
    The learner's mean_q metric rides the existing chunk-metrics sync; when it
    approaches an edge of the current support the support is re-derived with
-   that edge pushed out geometrically. Expansions are EDGE-TRIGGERED and
-   GEOMETRIC, so a run makes O(log(true range / initial range)) of them —
-   each costs one XLA recompile of the chunk program, which amortizes to
-   nothing (seconds against minutes-long rungs).
+   that edge pushed out. Expansions are EDGE-TRIGGERED and — when the caller
+   supplies `data_bounds_fn` — **DATA-CORROBORATED**: the new edge is the
+   CURRENT replay reward statistics run back through the rule-1 bound, and
+   a trigger whose data bound does NOT exceed the current edge is REFUSED.
+   mean_q is a prediction and can diverge; rewards cannot. Observed failure
+   (round 5, HalfCheetah seed 1, pre-guard): the critic diverged to
+   mean_q ≈ +2400 while actual episode returns sat near -400, and the
+   mean_q-only rule chased the fantasy from [-96, 639] to [-118, 5907] —
+   each expansion granting the divergence more room. With the guard the
+   trigger fires, the replay rewards say the data supports no more than the
+   warmup-scale bound, and the expansion is refused (counted in
+   `SupportController.refusals` for the metrics stream). Without
+   `data_bounds_fn` the legacy geometric growth is kept (unit isolation).
+   Each applied expansion costs one XLA recompile of the chunk program,
+   which amortizes to nothing (seconds against minutes-long rungs).
 
 Semantics under expansion: the critic's logits keep their per-atom meaning
 while the atom VALUES stretch, so predicted Q momentarily stretches with
@@ -66,6 +77,11 @@ GROWTH = 3.0
 COOLDOWN_STEPS = 2000
 # Headroom multiplier on the initial warmup-derived range.
 MARGIN = 1.2
+# A data-corroborated expansion must grow the span by at least this
+# fraction: a data bound scraping just past the current edge (percentile
+# jitter) would otherwise buy a sub-percent expansion at the cost of a
+# full XLA recompile, over and over.
+MIN_GROWTH = 0.1
 # Floor on the support width: degenerate all-equal-reward warmups (e.g.
 # zero-reward gridworlds) must still produce a usable support.
 MIN_HALF_WIDTH = 1.0
@@ -124,15 +140,46 @@ def initial_bounds(
     return center - half, center + half
 
 
+def replay_data_bounds(replay, gamma: float, n_step: int):
+    """The rule-1 bound over a replay's CURRENT reward column — the one
+    derivation every call site must share (initial sizing in agent.py and
+    train.py, and both expansion-corroboration closures): a drift between
+    sites would make the two training paths corroborate against different
+    statistics."""
+    rewards, discounts = replay.reward_sample()
+    return initial_bounds(rewards, gamma, n_step, discounts=discounts)
+
+
+def _edge_triggered(v_min: float, v_max: float, mean_q: float) -> bool:
+    """THE proximity predicate — shared by maybe_expand (the gate) and
+    SupportController (refusal classification), so the refusals metric can
+    never drift from what the gate actually refuses."""
+    if not np.isfinite(mean_q):
+        return False
+    near = PROXIMITY * max(abs(mean_q), MIN_HALF_WIDTH)
+    return v_max - mean_q < near or mean_q - v_min < near
+
+
 def maybe_expand(
     v_min: float,
     v_max: float,
     mean_q: float,
     steps_since_expansion: Optional[int] = None,
+    data_bounds_fn=None,
 ) -> Optional[Tuple[float, float]]:
-    """Edge-triggered geometric expansion. Returns new (v_min, v_max) when
-    mean_q has closed to within PROXIMITY * max(|mean_q|, MIN_HALF_WIDTH)
-    of either edge, else None (no change — the caller skips the recompile).
+    """Edge-triggered expansion. Returns new (v_min, v_max) when mean_q has
+    closed to within PROXIMITY * max(|mean_q|, MIN_HALF_WIDTH) of either
+    edge AND (when data_bounds_fn is given) the current replay data
+    corroborates growth on that edge, else None (no change — the caller
+    skips the recompile).
+
+    data_bounds_fn: zero-arg callable returning `initial_bounds` over the
+    replay's CURRENT reward column (called lazily, only after the proximity
+    trigger fires — the column pull is ~100k rows). The new edge is the
+    data-derived one; a trigger whose data bound does not exceed the
+    current edge is a diverging critic, not a grown return scale, and is
+    refused (see the module docstring's seed-1 incident). When None, the
+    legacy uncorroborated geometric growth is used.
 
     steps_since_expansion: learner steps since the caller last applied an
     expansion (None = never). Checks inside COOLDOWN_STEPS are refused —
@@ -144,16 +191,24 @@ def maybe_expand(
         and steps_since_expansion < COOLDOWN_STEPS
     ):
         return None
-    if not np.isfinite(mean_q):
+    if not _edge_triggered(v_min, v_max, mean_q):
         return None
     center = 0.5 * (v_min + v_max)
     half = 0.5 * (v_max - v_min)
     near = PROXIMITY * max(abs(mean_q), MIN_HALF_WIDTH)
-    if v_max - mean_q < near:
-        return v_min, center + GROWTH * half
-    if mean_q - v_min < near:
+    hi_edge = v_max - mean_q < near
+    lo_edge = mean_q - v_min < near
+    if data_bounds_fn is None:
+        if hi_edge:
+            return v_min, center + GROWTH * half
         return center - GROWTH * half, v_max
-    return None
+    lo_d, hi_d = data_bounds_fn()
+    min_step = MIN_GROWTH * (v_max - v_min)
+    if hi_edge and hi_d > v_max + min_step:
+        return v_min, float(hi_d)
+    if lo_edge and lo_d < v_min - min_step:
+        return float(lo_d), v_max
+    return None  # trigger fired but the data does not corroborate: refuse
 
 
 class SupportController:
@@ -164,22 +219,55 @@ class SupportController:
 
     def __init__(self):
         self._last_expand_step: Optional[int] = None
+        self._last_refusal_step: Optional[int] = None
+        # Proximity triggers refused by the data-corroboration gate — a
+        # nonzero, growing count in the metrics stream is the diverging-
+        # critic signature (mean_q pinned at an edge the data won't let
+        # grow), worth an operator's attention even though the support
+        # is, correctly, not chasing it.
+        self.refusals: int = 0
 
     def check(
-        self, v_min: float, v_max: float, mean_q: float, step: int
+        self,
+        v_min: float,
+        v_max: float,
+        mean_q: float,
+        step: int,
+        data_bounds_fn=None,
     ) -> Optional[Tuple[float, float]]:
         """maybe_expand with the cooldown applied; records the step when an
-        expansion fires. Returns the new bounds or None."""
+        expansion fires. Returns the new bounds or None.
+
+        Refusals are ALSO cooled down: a pinned diverged mean_q would
+        otherwise re-fire the trigger on every check and re-pay the
+        ~100k-row reward-column pull each time, for the rest of the run —
+        the replay contents cannot change faster than COOLDOWN_STEPS
+        anyway."""
+        since_expand = (
+            None
+            if self._last_expand_step is None
+            else step - self._last_expand_step
+        )
+        since_refusal = (
+            None
+            if self._last_refusal_step is None
+            else step - self._last_refusal_step
+        )
+        if since_refusal is not None and since_refusal < COOLDOWN_STEPS:
+            return None
         grown = maybe_expand(
-            v_min,
-            v_max,
-            mean_q,
-            steps_since_expansion=(
-                None
-                if self._last_expand_step is None
-                else step - self._last_expand_step
-            ),
+            v_min, v_max, mean_q,
+            steps_since_expansion=since_expand,
+            data_bounds_fn=data_bounds_fn,
         )
         if grown is not None:
             self._last_expand_step = step
-        return grown
+            return grown
+        if (
+            data_bounds_fn is not None
+            and (since_expand is None or since_expand >= COOLDOWN_STEPS)
+            and _edge_triggered(v_min, v_max, mean_q)
+        ):
+            self.refusals += 1
+            self._last_refusal_step = step
+        return None

@@ -132,8 +132,21 @@ class DeviceReplay:
         discipline exists to prevent). Single-process includes _pending so
         a just-warmed buffer is fully represented."""
         col = self.obs_dim + self.act_dim
-        n = min(len(self), max_n)
-        cols = np.asarray(jax.device_get(self.storage[:n, col : col + 2]))
+        size = len(self)
+        n = min(size, max_n)
+        if n == size:
+            cols = np.asarray(jax.device_get(self.storage[:n, col : col + 2]))
+        else:
+            # Evenly strided over the live region, not the [:n] prefix —
+            # a 1M-ring prefix can be ~900k insertions stale, and the
+            # round-5 corroboration gate would refuse legitimate
+            # expansions against long-gone rewards. Deterministic stride:
+            # replicas and strict_sync replays see identical samples.
+            idx = np.linspace(0, size - 1, n).astype(np.int64)
+            cols = np.asarray(
+                jax.device_get(jnp.take(self.storage[:, col : col + 2],
+                                        jnp.asarray(idx), axis=0))
+            )
         if self._procs == 1 and len(self._pending):
             cols = np.concatenate([cols, self._pending[:max_n, col : col + 2]])
         return cols[:, 0], cols[:, 1]

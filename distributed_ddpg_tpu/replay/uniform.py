@@ -41,9 +41,19 @@ class UniformReplay:
         """(reward, discount) columns, up to max_n rows — feeds the C51
         auto-support sizing (ops/support_auto.initial_bounds; the discount
         column marks terminal transitions, whose one-off rewards must not
-        enter the persistent-reward bound)."""
+        enter the persistent-reward bound).
+
+        Evenly STRIDED over the whole live region, not the [:max_n]
+        prefix: with a 1M-capacity ring a prefix is up to ~900k
+        insertions stale, and the round-5 data-corroboration gate would
+        refuse legitimate expansions against rewards the policy earned
+        long ago (deterministic stride, so strict_sync replays and
+        replicas see identical samples)."""
         n = min(self._size, max_n)
-        return self.reward[:n].copy(), self.discount[:n].copy()
+        if n == self._size:
+            return self.reward[:n].copy(), self.discount[:n].copy()
+        idx = np.linspace(0, self._size - 1, n).astype(np.int64)
+        return self.reward[idx], self.discount[idx]
 
     def add_batch(self, obs, action, reward, discount, next_obs) -> np.ndarray:
         """Insert B transitions; returns the slots written (for PER subclass)."""

@@ -676,11 +676,21 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # the initial compile.
             with phases.phase("sync"):
                 chunk_metrics = learner.metrics_to_host(out)
+            # data_bounds_fn: re-derive the rule-1 bound from the replay's
+            # CURRENT rewards so a diverging critic can't drag the support
+            # up (support_auto module docstring, seed-1 incident). The
+            # reward column is replica-identical (replay is replicated /
+            # lockstep-shipped across processes), so every replica still
+            # takes the same decision and the mesh cannot fork.
+            _support_source = device_replay if use_device_replay else replay
             grown = support_controller.check(
                 learner.config.v_min,
                 learner.config.v_max,
                 chunk_metrics["mean_q"],
                 learn_steps,
+                data_bounds_fn=lambda: support_auto.replay_data_bounds(
+                    _support_source, config.gamma, config.n_step
+                ),
             )
             if grown is not None:
                 learner.set_value_bounds(*grown)
@@ -691,7 +701,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     f"(mean_q {chunk_metrics['mean_q']:.1f})"
                 )
             support_metrics = dict(
-                v_min=learner.config.v_min, v_max=learner.config.v_max
+                v_min=learner.config.v_min,
+                v_max=learner.config.v_max,
+                support_refusals=support_controller.refusals,
             )
 
         if on_cadence and (config.strict_sync or now - last_log_t >= 1.0):
@@ -813,9 +825,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # reinterpret the restored critic. Must happen before the first
             # dispatch: jit is lazy, so the rebuild costs no extra compile.
             source = device_replay if use_device_replay else replay
-            rewards, discounts = source.reward_sample()
-            v_lo, v_hi = support_auto.initial_bounds(
-                rewards, config.gamma, config.n_step, discounts=discounts
+            v_lo, v_hi = support_auto.replay_data_bounds(
+                source, config.gamma, config.n_step
             )
             learner.set_value_bounds(v_lo, v_hi)
             print(

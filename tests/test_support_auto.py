@@ -186,6 +186,85 @@ class TestMaybeExpand:
             is not None
         )
 
+    def test_diverged_critic_refused_by_data(self):
+        # Round-5 HalfCheetah seed-1 incident (module docstring): critic
+        # diverged to mean_q ≈ +2400 while replay rewards stayed at the
+        # random-policy scale (returns ≈ -400); the mean_q-only rule
+        # expanded [-96, 639] -> ... -> [-118, 5907], giving the
+        # divergence more room each time. With data corroboration the
+        # trigger fires but the replay rewards cap the support: refused.
+        flat = lambda: (-120.0, 640.0)  # data bound ~= the current support
+        assert (
+            support_auto.maybe_expand(
+                -96.0, 639.0, 560.0, data_bounds_fn=flat
+            )
+            is None
+        )
+
+    def test_grown_data_bound_drives_the_new_edge(self):
+        # Healthy growth: the policy actually earns bigger rewards, the
+        # rule-1 bound over the CURRENT replay outgrows the support, and
+        # the expansion lands exactly on the data-derived edge (one
+        # recompile straight to the supported size, not blind 3x hops).
+        grown = support_auto.maybe_expand(
+            -96.0, 639.0, 560.0, data_bounds_fn=lambda: (-130.0, 2500.0)
+        )
+        assert grown == (-96.0, 2500.0)
+
+    def test_low_edge_corroboration_symmetric(self):
+        grown = support_auto.maybe_expand(
+            -150.0, 150.0, -140.0, data_bounds_fn=lambda: (-900.0, 100.0)
+        )
+        assert grown == (-900.0, 150.0)
+        assert (
+            support_auto.maybe_expand(
+                -150.0, 150.0, -140.0, data_bounds_fn=lambda: (-150.0, 100.0)
+            )
+            is None
+        )
+
+    def test_data_fn_not_called_without_trigger(self):
+        # The reward-column pull is ~100k rows; it must be lazy.
+        def boom():
+            raise AssertionError("data_bounds_fn called without a trigger")
+
+        assert (
+            support_auto.maybe_expand(-150.0, 150.0, 0.0, data_bounds_fn=boom)
+            is None
+        )
+
+    def test_controller_counts_refusals_with_cooldown(self):
+        ctl = support_auto.SupportController()
+        calls = 0
+
+        def flat():
+            nonlocal calls
+            calls += 1
+            return (-120.0, 640.0)
+
+        cd = support_auto.COOLDOWN_STEPS
+        # Refusals are cooled down like expansions: a pinned diverged
+        # mean_q must not re-pay the reward-column pull every check.
+        for step, want_refusals in (
+            (50, 1),          # trigger fires, data refuses
+            (100, 1),         # inside the refusal cooldown: silently held
+            (50 + cd, 2),     # re-armed, refused again
+            (100 + 2 * cd, 3),
+        ):
+            assert (
+                ctl.check(-96.0, 639.0, 560.0, step, data_bounds_fn=flat)
+                is None
+            )
+            assert ctl.refusals == want_refusals
+        assert calls == 3  # the held check never pulled the column
+        # A corroborated expansion still applies and does not count.
+        grown = ctl.check(
+            -96.0, 639.0, 560.0, 200 + 3 * cd,
+            data_bounds_fn=lambda: (-120.0, 2500.0),
+        )
+        assert grown == (-96.0, 2500.0)
+        assert ctl.refusals == 3
+
 
 class TestConfigPlumbing:
     def test_auto_flag_parses_to_nan(self):

@@ -112,6 +112,47 @@ def test_train_jax_tiny_budget_takes_at_least_one_chunk(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_jax_auto_support_resolves_and_reports(tmp_path):
+    """train_jax with --v_min=auto --v_max=auto: the warmup sizing must
+    resolve concrete bounds before the first dispatch, and the running
+    expansion check (incl. the round-5 data-corroboration closure over
+    replay.reward_sample) must execute without error and report
+    v_min/v_max/support_refusals in the metrics stream."""
+    import json
+
+    path = tmp_path / "metrics.jsonl"
+    cfg = DDPGConfig(
+        distributional=True,
+        num_atoms=11,
+        v_min=float("nan"),  # the 'auto' sentinel (config.from_flags)
+        v_max=float("nan"),
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=1,
+        total_env_steps=1_200,
+        replay_min_size=256,
+        replay_capacity=5_000,
+        eval_every=0,
+        # Lockstep + a tiny pinned chunk: the support metrics ride the
+        # 50*chunk cadence, which a free-running tiny budget never reaches
+        # (the whole env budget can drain during the first compile).
+        max_learn_ratio=1.0,
+        max_ingest_ratio=1.0,
+        learner_chunk=4,
+        log_path=str(path),
+    )
+    out = train_jax(cfg)
+    assert out["learner_steps"] > 0
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    sup = [r for r in rows if "v_min" in r and "v_max" in r]
+    assert sup, "no support metrics reported"
+    assert all(np.isfinite(r["v_min"]) and np.isfinite(r["v_max"])
+               for r in sup)
+    assert all(r["v_min"] < r["v_max"] for r in sup)
+    assert "support_refusals" in sup[-1]
+
+
+@pytest.mark.slow
 def test_train_jax_async_pipeline(tmp_path):
     cfg = DDPGConfig(
         backend="jax_tpu",
