@@ -74,8 +74,53 @@ def _snapshot(
     return ckpt
 
 
+# Checkpoints RETAINED after each successful write (latest N). A
+# checkpoint with a full 1M-row replay is ~3 GB; without retention a
+# 2M-step Humanoid run at checkpoint_every=10k writes ~200 of them
+# (~hundreds of GB) and fills the disk mid-run — observed round 5 at
+# 6.4 GB by 340k steps. 3 matches the spirit of the reference family's
+# tf.train.Saver default (keep a few, not all): latest for resume, two
+# back in case the newest write raced a crash.
+KEEP_CHECKPOINTS = 3
+
+
+def _steps(directory: str):
+    """All step numbers present in a checkpoint directory — THE parser for
+    the step_N naming scheme, shared by pruning and latest_step so the two
+    can never disagree about what exists."""
+    return sorted(
+        int(name.split("_", 1)[1])
+        for name in os.listdir(directory)
+        if name.startswith("step_") and name.split("_", 1)[1].isdigit()
+    )
+
+
+def _prune(directory: str, keep: int, current: int) -> None:
+    """Delete old step_*/config_* pairs, retaining the newest `keep` —
+    ALWAYS including `current`, the checkpoint that just landed: sorting
+    alone would delete the fresh save when the directory holds
+    higher-numbered stale checkpoints from a previous run (the
+    --resume=false reuse workflow check_config_compatible suggests).
+    Runs on the writer thread after a successful save; best-effort (a
+    failed unlink must not fail the save that just landed)."""
+    import shutil
+
+    if keep <= 0:
+        return
+    others = [s for s in _steps(directory) if s != current]
+    for old in others[: -(keep - 1)] if keep > 1 else others:
+        try:
+            shutil.rmtree(os.path.join(directory, f"step_{old}"),
+                          ignore_errors=True)
+            cfg_path = os.path.join(directory, f"config_{old}.json")
+            if os.path.exists(cfg_path):
+                os.unlink(cfg_path)
+        except OSError:
+            pass
+
+
 def _write(directory: str, step: int, ckpt: Dict[str, Any],
-           config: Optional[DDPGConfig]) -> str:
+           config: Optional[DDPGConfig], keep: int = KEEP_CHECKPOINTS) -> str:
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, ckpt)
@@ -89,6 +134,7 @@ def _write(directory: str, step: int, ckpt: Dict[str, Any],
         }
         with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
             json.dump(fields, f, indent=2, default=list)
+    _prune(os.path.dirname(path), keep, step)
     return path
 
 
@@ -100,12 +146,14 @@ def save(
     config: Optional[DDPGConfig] = None,
     env_steps: int = 0,
     v_bounds=None,
+    keep: int = KEEP_CHECKPOINTS,
 ) -> str:
     """Write checkpoint `directory/step_N` synchronously. Returns the path."""
     return _write(
         directory, step,
         _snapshot(step, state, replay, env_steps, v_bounds=v_bounds),
         config,
+        keep=keep,
     )
 
 
@@ -140,6 +188,7 @@ class AsyncSaver:
         config: Optional[DDPGConfig] = None,
         env_steps: int = 0,
         v_bounds=None,
+        keep: int = KEEP_CHECKPOINTS,
     ) -> bool:
         """Snapshot now, write in the background. Returns False (and skips)
         if the previous write is still in flight."""
@@ -153,7 +202,7 @@ class AsyncSaver:
 
             def _run():
                 try:
-                    _write(directory, step, ckpt, config)
+                    _write(directory, step, ckpt, config, keep=keep)
                 except Exception as e:  # surfaced via .errors / wait()
                     self.errors.append(e)
 
@@ -216,11 +265,7 @@ def _compat_eq(a, b) -> bool:
 def latest_step(directory: str) -> Optional[int]:
     if not os.path.isdir(directory):
         return None
-    steps = [
-        int(name.split("_", 1)[1])
-        for name in os.listdir(directory)
-        if name.startswith("step_") and name.split("_", 1)[1].isdigit()
-    ]
+    steps = _steps(directory)
     return max(steps) if steps else None
 
 

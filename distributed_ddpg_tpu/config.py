@@ -220,6 +220,10 @@ class DDPGConfig:
     eval_episodes: int = 5
     checkpoint_every: int = 10_000
     checkpoint_dir: str = ""
+    # Latest-N retention: a full-replay checkpoint is ~3 GB (1M rows), so
+    # keeping every cadence point fills a disk mid-run (round-5 incident:
+    # 6.4 GB by 340k steps of a 2M-step Humanoid run). 0 = keep all.
+    checkpoint_keep: int = 3
     resume: bool = True              # auto-restore latest checkpoint_dir state
     log_path: str = ""               # JSONL metrics path ("" = stdout only)
     tb_dir: str = ""                 # TensorBoard summary dir ("" = off)
@@ -384,6 +388,8 @@ class DDPGConfig:
             raise ValueError("max_ingest_ratio must be >= 0 (0 = unlimited)")
         if self.learner_chunk < 0:
             raise ValueError("learner_chunk must be >= 0 (0 = auto)")
+        if self.checkpoint_keep < 0:
+            raise ValueError("checkpoint_keep must be >= 0 (0 = keep all)")
         if self.max_learn_ratio < 0:
             raise ValueError("max_learn_ratio must be >= 0 (0 = unlimited)")
         if self.actor_throttle_s < 0:

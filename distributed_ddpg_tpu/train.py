@@ -286,6 +286,7 @@ def train_ondevice(config: DDPGConfig) -> Dict[str, float]:
                     config.checkpoint_dir, trainer.learn_steps,
                     jax.device_get(trainer.state), _ReplayView(), config,
                     env_steps=env_steps(),
+                    keep=config.checkpoint_keep,
                 )
                 last_ckpt = trainer.learn_steps
 
@@ -755,6 +756,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                         if config.distributional and config.v_support_auto
                         else None
                     ),
+                    keep=config.checkpoint_keep,
                 )
             last_ckpt = learn_steps
 

@@ -215,6 +215,39 @@ def test_checkpoint_roundtrip_with_replay(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_checkpoint_retention_prunes_old_steps(tmp_path):
+    """Latest-N retention (round-5 disk incident: a full-replay checkpoint
+    is ~3 GB and the saver kept every cadence point — a 2M-step run would
+    fill the disk). Old step_*/config_* pairs must go; keep=0 keeps all;
+    restore must still find the latest."""
+    cfg = DDPGConfig(actor_hidden=(16, 16), critic_hidden=(16, 16))
+    state = init_train_state(cfg, 4, 2, seed=0)
+    for step in (10, 20, 30, 40, 50):
+        ckpt_lib.save(str(tmp_path), step, state, None, cfg, keep=3)
+    kept = sorted(p for p in os.listdir(tmp_path) if p.startswith("step_"))
+    assert kept == ["step_30", "step_40", "step_50"]
+    cfgs = sorted(p for p in os.listdir(tmp_path) if p.startswith("config_"))
+    assert cfgs == ["config_30.json", "config_40.json", "config_50.json"]
+    assert ckpt_lib.latest_step(str(tmp_path)) == 50
+    # keep=0 disables pruning entirely.
+    ckpt_lib.save(str(tmp_path), 60, state, None, cfg, keep=0)
+    assert len([p for p in os.listdir(tmp_path) if p.startswith("step_")]) == 4
+
+
+def test_checkpoint_retention_protects_fresh_save_from_stale_dirs(tmp_path):
+    """A fresh run reusing a directory with HIGHER-numbered stale
+    checkpoints (the --resume=false reuse workflow) must never prune the
+    checkpoint it just wrote — numeric sorting alone would."""
+    cfg = DDPGConfig(actor_hidden=(16, 16), critic_hidden=(16, 16))
+    state = init_train_state(cfg, 4, 2, seed=0)
+    for stale in (100_000, 110_000, 120_000):
+        ckpt_lib.save(str(tmp_path), stale, state, None, cfg, keep=0)
+    ckpt_lib.save(str(tmp_path), 10_000, state, None, cfg, keep=3)
+    kept = {p for p in os.listdir(tmp_path) if p.startswith("step_")}
+    assert "step_10000" in kept, "the just-written checkpoint was pruned"
+    assert len(kept) == 3
+
+
 @pytest.mark.slow
 def test_train_jax_device_replay_path(tmp_path):
     """Uniform replay -> device-resident buffer with fused on-device
