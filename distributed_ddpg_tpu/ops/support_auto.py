@@ -23,9 +23,12 @@ knob with two rules:
    The learner's mean_q metric rides the existing chunk-metrics sync; when it
    approaches an edge of the current support the support is re-derived with
    that edge pushed out. Expansions are EDGE-TRIGGERED and — when the caller
-   supplies `data_bounds_fn` — **DATA-CORROBORATED**: the new edge is the
-   CURRENT replay reward statistics run back through the rule-1 bound, and
-   a trigger whose data bound does NOT exceed the current edge is REFUSED.
+   supplies `data_bounds_fn` — **DATA-CORROBORATED**: the rule-1 bound over
+   the replay's CURRENT rewards must exceed the current edge for the
+   expansion to happen at all (else REFUSED), and the new edge is then the
+   LARGER of that data bound and the geometric step — the data gates, the
+   geometry sizes (the percentile bound lags achievable return; capping at
+   it measurably throttled a healthy run — see the in-function comment).
    mean_q is a prediction and can diverge; rewards cannot. Observed failure
    (round 5, HalfCheetah seed 1, pre-guard): the critic diverged to
    mean_q ≈ +2400 while actual episode returns sat near -400, and the
@@ -77,10 +80,13 @@ GROWTH = 3.0
 COOLDOWN_STEPS = 2000
 # Headroom multiplier on the initial warmup-derived range.
 MARGIN = 1.2
-# A data-corroborated expansion must grow the span by at least this
-# fraction: a data bound scraping just past the current edge (percentile
-# jitter) would otherwise buy a sub-percent expansion at the cost of a
-# full XLA recompile, over and over.
+# Corroboration strictness: the data bound must exceed the current edge
+# by at least this fraction of the span for an expansion to pass the
+# gate. This does NOT size the expansion (a corroborated trigger always
+# gets at least the geometric step); it sets how far past the edge the
+# replay rewards must reach before growth is believed — tightening it
+# strengthens the diverged-critic guard, loosening it expands earlier on
+# percentile jitter.
 MIN_GROWTH = 0.1
 # Floor on the support width: degenerate all-equal-reward warmups (e.g.
 # zero-reward gridworlds) must still produce a usable support.
@@ -175,11 +181,14 @@ def maybe_expand(
 
     data_bounds_fn: zero-arg callable returning `initial_bounds` over the
     replay's CURRENT reward column (called lazily, only after the proximity
-    trigger fires — the column pull is ~100k rows). The new edge is the
-    data-derived one; a trigger whose data bound does not exceed the
-    current edge is a diverging critic, not a grown return scale, and is
-    refused (see the module docstring's seed-1 incident). When None, the
-    legacy uncorroborated geometric growth is used.
+    trigger fires — the column pull is ~100k rows). The data bound GATES:
+    a trigger whose data bound does not meaningfully exceed the current
+    edge is a diverging critic, not a grown return scale, and is refused
+    (module docstring, seed-1 incident). A corroborated trigger grows to
+    the LARGER of the data bound and the geometric step — the data is a
+    lagging estimator and capping at it measurably throttles healthy runs
+    (in-function comment). When None, the legacy uncorroborated geometric
+    growth is used.
 
     steps_since_expansion: learner steps since the caller last applied an
     expansion (None = never). Checks inside COOLDOWN_STEPS are refused —
@@ -204,10 +213,19 @@ def maybe_expand(
         return center - GROWTH * half, v_max
     lo_d, hi_d = data_bounds_fn()
     min_step = MIN_GROWTH * (v_max - v_min)
+    # The data bound GATES the expansion but does not CAP the new edge:
+    # the percentile-derived bound is a lagging estimator of achievable
+    # return (measured round 5, HalfCheetah seed 0 — capping the edge at
+    # the data bound throttled healthy growth to 3672 where the
+    # uncorroborated rule reached 5075), so a corroborated trigger gets
+    # the full geometric headroom. Runaway stays bounded: the NEXT
+    # expansion needs the data to corroborate again above the grown
+    # edge, so a diverged critic buys at most one geometric step beyond
+    # what the rewards ever supported (vs unbounded chasing pre-guard).
     if hi_edge and hi_d > v_max + min_step:
-        return v_min, float(hi_d)
+        return v_min, float(max(hi_d, center + GROWTH * half))
     if lo_edge and lo_d < v_min - min_step:
-        return float(lo_d), v_max
+        return float(min(lo_d, center - GROWTH * half)), v_max
     return None  # trigger fired but the data does not corroborate: refuse
 
 

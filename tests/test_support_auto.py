@@ -211,6 +211,18 @@ class TestMaybeExpand:
         )
         assert grown == (-96.0, 2500.0)
 
+    def test_corroborated_trigger_gets_geometric_headroom(self):
+        # The data bound gates but does not cap (HalfCheetah seed-0
+        # round-5 measurement: capping at the lagging percentile bound
+        # throttled a healthy run to 3672 vs 5075 uncapped). Data just
+        # past the gate -> the GEOMETRIC edge wins when larger.
+        grown = support_auto.maybe_expand(
+            -118.0, 70.0, 55.0, data_bounds_fn=lambda: (-118.0, 120.0)
+        )
+        assert grown is not None
+        # geometric: center -24 + 3*94 = 258 > data 120
+        assert grown[1] > 250.0
+
     def test_low_edge_corroboration_symmetric(self):
         grown = support_auto.maybe_expand(
             -150.0, 150.0, -140.0, data_bounds_fn=lambda: (-900.0, 100.0)
