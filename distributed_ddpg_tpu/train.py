@@ -383,6 +383,25 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     min_fill = max(config.replay_min_size, config.batch_size)
     n_proc = jax.process_count()
     if (
+        config.host_replay
+        and config.distributional
+        and config.v_support_auto
+        and n_proc > 1
+    ):
+        # Fail FAST, before mesh/learner construction: host replay is
+        # process-LOCAL (each process ingests its own actors), so the
+        # auto-support warmup sizing and every data-corroboration check
+        # would derive DIFFERENT bounds per replica — different compiled
+        # Bellman targets on each process, a silent mesh fork. Device
+        # replay is replicated (lockstep sync_ship), which is what makes
+        # the decisions replica-identical.
+        raise ValueError(
+            "v_min/v_max=auto with --host_replay is not supported "
+            "multi-process: per-process replay statistics would fork the "
+            "replicas' compiled programs. Use the device replay path "
+            "(default) or concrete v_min/v_max."
+        )
+    if (
         config.max_learn_ratio > 0.0
         and config.max_ingest_ratio > 0.0
         and chunk > (1.0 + config.max_learn_ratio * n_proc) * min_fill

@@ -215,6 +215,28 @@ def test_checkpoint_roundtrip_with_replay(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_auto_support_host_replay_multiprocess_rejected(monkeypatch):
+    """Host replay is process-local; auto-support bounds derived from it
+    would differ per replica and fork the compiled programs — train_jax
+    must refuse the combination loudly."""
+    import jax as jax_mod
+
+    monkeypatch.setattr(jax_mod, "process_count", lambda: 2)
+    cfg = DDPGConfig(
+        distributional=True,
+        num_atoms=11,
+        v_min=float("nan"),
+        v_max=float("nan"),
+        host_replay=True,
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        total_env_steps=256,
+        replay_min_size=128,
+    )
+    with pytest.raises(ValueError, match="host_replay.*multi-process"):
+        train_jax(cfg)
+
+
 def test_checkpoint_retention_prunes_old_steps(tmp_path):
     """Latest-N retention (round-5 disk incident: a full-replay checkpoint
     is ~3 GB and the saver kept every cadence point — a 2M-step run would
