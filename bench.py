@@ -26,7 +26,9 @@ emits the JSON line, and exits 0 as long as the native baseline ran.
 Env overrides: JAX_PLATFORMS / BENCH_PLATFORM force the accelerator phase's
 platform (smoke-testing); BENCH_SECONDS scales measurement length;
 BENCH_SCALING=0 skips the virtual-device scaling curve; BENCH_CHUNK
-overrides the learner chunk length for the accelerator phase.
+overrides the learner chunk length for the accelerator phase;
+BENCH_INGEST_ASYNC=0 / BENCH_INGEST_COALESCE=1 fall back to the seed's
+serial inline replay ingest for A/B runs (docs/INGEST.md).
 """
 
 from __future__ import annotations
@@ -173,12 +175,22 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     learner = ShardedLearner(
         config, OBS_DIM, ACT_DIM, action_scale=1.0, chunk_size=chunk, mesh=mesh
     )
+    # Production ingest pipeline (docs/INGEST.md): coalesced host-ring
+    # staging + background shipper, exactly what train_jax runs.
+    # BENCH_INGEST_ASYNC=0 / BENCH_INGEST_COALESCE=1 recover the seed's
+    # serial inline shipping for A/B measurements.
     device_replay = DeviceReplay(
-        config.replay_capacity, OBS_DIM, ACT_DIM, mesh=learner.mesh, block_size=4096
+        config.replay_capacity, OBS_DIM, ACT_DIM, mesh=learner.mesh,
+        block_size=4096,
+        async_ship=os.environ.get("BENCH_INGEST_ASYNC", "1") == "1",
+        max_coalesce=int(os.environ.get("BENCH_INGEST_COALESCE",
+                                        str(config.ingest_coalesce))),
     )
     # Initial fill mirroring the host replay contents (warm buffer).
     idx = np.arange(len(replay))
     device_replay.add_packed(pack_batch_np(replay.gather(idx)))
+    device_replay.drain_pending()  # warm fill fully landed before timing
+    device_replay.ingest_snapshot()  # reset: measure only the loop's ingest
 
     rng = np.random.default_rng(1)
     ingest_rows = rng.standard_normal((4096, device_replay.width)).astype(np.float32)
@@ -210,6 +222,8 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     _ = float(out.metrics["critic_loss"])  # sync on the last chunk
     elapsed = time.perf_counter() - t0
     rate = steps / elapsed
+    ingest = device_replay.ingest_snapshot()
+    device_replay.close()
 
     dev = jax.devices()[0]
     n_dev = learner.mesh.size
@@ -229,8 +243,13 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
         ),
         # Per-phase breakdown (SURVEY.md §5): mean chunk dispatch(+compute
         # backpressure) time vs actor-ingest h2d time per loop iteration.
+        # t_ingest_ms is the CALLER-VISIBLE (learner critical path) cost;
+        # the ingest_* fields (metrics.IngestStats) describe what the
+        # pipeline did off-path: rows/sec landed, blocks coalesced per
+        # device call, producer stall on backpressure, queue depth.
         "t_dispatch_ms": round(1000.0 * t_dispatch / max(dispatches, 1), 3),
         "t_ingest_ms": round(1000.0 * t_ingest / max(dispatches, 1), 3),
+        **ingest,
     }
     peak = _peak_flops(dev.device_kind)
     if peak is not None:
@@ -303,6 +322,40 @@ def phase_jax() -> dict:
         return result
 
 
+def phase_ingest() -> dict:
+    """Fast CPU ingest microbenchmark (tier-1 smoke: tests/
+    test_ingest_pipeline.py runs it in-process): a tiny learner + the
+    production coalesced/async ingest pipeline on a 1-device mesh, short
+    enough for CI but exercising the same _measure_jax path the headline
+    and scaling numbers use. Asserting on its JSON keys makes an ingest
+    observability regression (or a pipeline exception) a test failure
+    instead of a surprise in the next round bench."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "2"))
+    config = _config().replace(
+        actor_hidden=(32, 32), critic_hidden=(32, 32),
+        replay_capacity=65_536, fused_chunk="off",
+    )
+    replay = _fill_replay(config, n=20_000)
+    mesh = mesh_lib.make_mesh(data_axis=1, devices=jax.devices()[:1])
+    r = _measure_jax(config, replay, seconds, mesh=mesh, chunk=8)
+    return {
+        "ingest_bench": {
+            k: r[k]
+            for k in (
+                "rate", "t_dispatch_ms", "t_ingest_ms",
+                "ingest_rows_per_sec", "ingest_ship_calls",
+                "ingest_coalesce_mean", "ingest_stall_ms",
+                "ingest_ship_ms", "ingest_queue_rows",
+            )
+        }
+    }
+
+
 def phase_scaling() -> dict:
     """Data-parallel scaling curves on N virtual CPU devices (the multi-chip
     stand-in this 1-chip environment allows). The orchestrator sets
@@ -340,6 +393,9 @@ def phase_scaling() -> dict:
                 "rows_per_sec": round(r["rate"] * r["global_batch"], 1),
                 "t_dispatch_ms": r["t_dispatch_ms"],
                 "t_ingest_ms": r["t_ingest_ms"],
+                "ingest_rows_per_sec": r["ingest_rows_per_sec"],
+                "ingest_coalesce_mean": r["ingest_coalesce_mean"],
+                "ingest_stall_ms": r["ingest_stall_ms"],
             }
         curves[label] = curve
     return {"scaling_cpu_virtual": curves}
@@ -424,6 +480,7 @@ _PHASES = {
     "native": phase_native,
     "probe": phase_probe,
     "jax": phase_jax,
+    "ingest": phase_ingest,
     "scaling": phase_scaling,
     "study": phase_study,
 }
@@ -674,7 +731,11 @@ def main() -> int:
         result["n_devices"] = accel["n_devices"]
         result["per_device_rate"] = round(accel["per_device_rate"], 1)
         for key in ("t_dispatch_ms", "t_ingest_ms", "chunk",
-                    "fused_chunk_error", "fused_chunk_active"):
+                    "fused_chunk_error", "fused_chunk_active",
+                    "ingest_rows_per_sec", "ingest_rows_staged",
+                    "ingest_ship_calls", "ingest_coalesce_mean",
+                    "ingest_stall_ms", "ingest_ship_ms",
+                    "ingest_queue_rows"):
             if key in accel:
                 result[key] = accel[key]
         if "mfu" in accel:

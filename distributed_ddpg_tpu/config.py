@@ -109,6 +109,18 @@ class DDPGConfig:
     # device memory; the device path (uniform AND prioritized) is the
     # flagship zero-h2d steady state.
     host_replay: bool = False
+    # Device-replay ingest pipeline (replay/device.py; docs/INGEST.md).
+    # ingest_async moves single-process host->HBM shipping onto a
+    # background shipper thread (bounded by the staging ring; a full ring
+    # blocks the drain — backpressure) so insert dispatch overlaps learner
+    # compute. Forced off under strict_sync (row-landing timing would make
+    # the sampled stream a function of host scheduling, breaking the
+    # bit-identical-two-runs contract) and on multi-host (rows leave only
+    # via the lockstep sync_ship collective). ingest_coalesce caps how
+    # many staged blocks fold into one device_put + jitted scatter
+    # (power-of-two groups; 1 = the seed's serial block-at-a-time ships).
+    ingest_async: bool = True
+    ingest_coalesce: int = 8
 
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15
@@ -308,6 +320,8 @@ class DDPGConfig:
             raise ValueError(
                 f"fused_mesh must be 'auto' or 'off', got {self.fused_mesh!r}"
             )
+        if self.ingest_coalesce < 1:
+            raise ValueError("ingest_coalesce must be >= 1")
         if self.policy_delay < 1:
             raise ValueError("policy_delay must be >= 1")
         if self.target_noise < 0 or self.target_noise_clip < 0:

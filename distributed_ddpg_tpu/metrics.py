@@ -110,6 +110,79 @@ class PhaseTimers:
         return out
 
 
+class IngestStats:
+    """Thread-safe counters for the replay ingest pipeline (docs/INGEST.md;
+    the inbound mirror of PhaseTimers' outbound sample/h2d breakdown).
+
+    Producers call record_push (rows staged + time spent stalled on a full
+    staging ring); the shipper calls record_ship (rows/blocks moved to HBM
+    per device call + the dispatch wall time). snapshot() emits the
+    `ingest_*` fields each train/bench record carries and resets the
+    interval, so every JSONL line describes its own window:
+
+      ingest_rows_per_sec   rows landed in HBM over the interval
+      ingest_rows_staged    rows pushed into the staging ring over the
+                            interval (staged - shipped trending up =
+                            backlog growth)
+      ingest_ship_calls     device_put+insert dispatches in the interval
+      ingest_coalesce_mean  staged blocks folded into one dispatch (>=1;
+                            1.0 = no coalescing happened = inflow arrived
+                            slower than one block per ship)
+      ingest_stall_ms       total time producers blocked on backpressure
+      ingest_ship_ms        mean dispatch wall time per ship call
+      ingest_queue_rows     staged rows not yet shipped (queue depth)
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._rows_in = 0
+        self._rows_shipped = 0
+        self._blocks_shipped = 0
+        self._ship_calls = 0
+        self._stall_s = 0.0
+        self._ship_s = 0.0
+
+    def record_push(self, rows: int, stall_s: float = 0.0) -> None:
+        with self._lock:
+            self._rows_in += int(rows)
+            self._stall_s += stall_s
+
+    def record_ship(self, rows: int, blocks: int, ship_s: float = 0.0) -> None:
+        with self._lock:
+            self._rows_shipped += int(rows)
+            self._blocks_shipped += int(blocks)
+            self._ship_calls += 1
+            self._ship_s += ship_s
+
+    def snapshot(self, pending_rows: int = 0, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            dt = max(time.monotonic() - self._t0, 1e-9)
+            calls = self._ship_calls
+            out = {
+                "ingest_rows_per_sec": round(self._rows_shipped / dt, 1),
+                "ingest_rows_staged": self._rows_in,
+                "ingest_ship_calls": calls,
+                "ingest_coalesce_mean": (
+                    round(self._blocks_shipped / calls, 3) if calls else 0.0
+                ),
+                "ingest_stall_ms": round(1000.0 * self._stall_s, 3),
+                "ingest_ship_ms": (
+                    round(1000.0 * self._ship_s / calls, 3) if calls else 0.0
+                ),
+                "ingest_queue_rows": int(pending_rows),
+            }
+            if reset:
+                self._t0 = time.monotonic()
+                self._rows_in = 0
+                self._rows_shipped = 0
+                self._blocks_shipped = 0
+                self._ship_calls = 0
+                self._stall_s = 0.0
+                self._ship_s = 0.0
+        return out
+
+
 class Timer:
     """Running steps/sec meter for the actor/learner rate metrics."""
 

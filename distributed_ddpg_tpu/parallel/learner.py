@@ -24,6 +24,7 @@ between steps.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, Optional
 
 import jax
@@ -46,6 +47,17 @@ from distributed_ddpg_tpu.types import (
     pack_batch_np,
     unpack_batch,
 )
+
+def _ingest_lock(device_replay):
+    """The replay's dispatch lock (replay/device.py): chunk dispatch must
+    not interleave with the async ingest shipper's donate-and-swap of
+    storage (a donated-away buffer read mid-swap is a deleted-array
+    error), and the PER read -> dispatch -> set_per_state sequence must be
+    atomic against shipper priority stamps (a stamp landing inside that
+    window would be overwritten and leave fresh rows at priority 0).
+    Dispatch is async, so the hold time is the enqueue, not the compute."""
+    return getattr(device_replay, "dispatch_lock", None) or contextlib.nullcontext()
+
 
 def resolve_learner_chunk(config: DDPGConfig) -> int:
     """Production learner steps-per-dispatch: config.learner_chunk when set,
@@ -171,7 +183,7 @@ class ShardedLearner:
             bspec = mesh_lib.batch_pspec()
 
             def step(s: TrainState, b: Batch) -> StepOutput:
-                return jax.shard_map(
+                return mesh_lib.shard_map(
                     inner,
                     mesh=self.mesh,
                     in_specs=(state_spec, bspec),
@@ -180,7 +192,6 @@ class ShardedLearner:
                         td_errors=P("data"),
                         metrics={k: P() for k in METRIC_KEYS},
                     ),
-                    check_vma=False,
                 )(s, b)
 
         replicated = NamedSharding(self.mesh, P())
@@ -534,7 +545,7 @@ class ShardedLearner:
             )
             return new_s, tds, {k: avg(v) for k, v in ms.items()}
 
-        sharded = jax.shard_map(
+        sharded = mesh_lib.shard_map(
             local_chunk,
             mesh=mesh,
             in_specs=(state_spec, P(), P(None, None), P()),
@@ -543,7 +554,6 @@ class ShardedLearner:
                 P(None, "data"),
                 {k: P() for k in METRIC_KEYS},
             ),
-            check_vma=False,
         )
 
         def fused_mesh_sample_chunk_fn(s: TrainState, key, storage, size):
@@ -594,42 +604,43 @@ class ShardedLearner:
         the first dispatch and to intact inputs: donation consumes buffers
         at invoke (not on success), so a post-compile execution failure
         must re-raise rather than retry against deleted arrays."""
-        storage, size = device_replay.device_state()
-        try:
-            out, self._key = self._sample_chunk_step(
-                self.state, self._key, storage, size
-            )
-        except Exception as e:
-            retryable = (
-                self.fused_chunk_active
-                and self.config.fused_chunk == "auto"
-                and not self._sample_chunk_compiled
-                and not any(
-                    getattr(leaf, "is_deleted", lambda: False)()
-                    for leaf in jax.tree.leaves((self.state, self._key))
+        with _ingest_lock(device_replay):
+            storage, size = device_replay.device_state()
+            try:
+                out, self._key = self._sample_chunk_step(
+                    self.state, self._key, storage, size
                 )
-            )
-            if not retryable:
-                raise
-            import warnings
+            except Exception as e:
+                retryable = (
+                    self.fused_chunk_active
+                    and self.config.fused_chunk == "auto"
+                    and not self._sample_chunk_compiled
+                    and not any(
+                        getattr(leaf, "is_deleted", lambda: False)()
+                        for leaf in jax.tree.leaves((self.state, self._key))
+                    )
+                )
+                if not retryable:
+                    raise
+                import warnings
 
-            warnings.warn(
-                "fused_chunk='auto': megakernel failed on this backend; "
-                f"falling back to the XLA scan path: {e!r}"
-            )
-            self.fused_chunk_error = repr(e)[:800]
-            self.fused_chunk_active = False
-            self.fused_mesh_active = False  # scan = per-step psum semantics
-            # Same kernel program backs the PER variant — don't re-fail there.
-            self.fused_per_active = False
-            self._per_sample_chunk_step = self._scan_per_sample_chunk_step
-            self._sample_chunk_step = self._scan_sample_chunk_step
-            out, self._key = self._sample_chunk_step(
-                self.state, self._key, storage, size
-            )
-        self._sample_chunk_compiled = True
-        self.state = out.state
-        return out
+                warnings.warn(
+                    "fused_chunk='auto': megakernel failed on this backend; "
+                    f"falling back to the XLA scan path: {e!r}"
+                )
+                self.fused_chunk_error = repr(e)[:800]
+                self.fused_chunk_active = False
+                self.fused_mesh_active = False  # scan = per-step psum semantics
+                # Same kernel program backs the PER variant — don't re-fail there.
+                self.fused_per_active = False
+                self._per_sample_chunk_step = self._scan_per_sample_chunk_step
+                self._sample_chunk_step = self._scan_sample_chunk_step
+                out, self._key = self._sample_chunk_step(
+                    self.state, self._key, storage, size
+                )
+            self._sample_chunk_compiled = True
+            self.state = out.state
+            return out
 
     def run_sample_chunk_per(self, device_replay, beta: float) -> StepOutput:
         """K learner steps with proportional PER sampling + priority update
@@ -639,48 +650,49 @@ class ShardedLearner:
         run in one pallas launch (draw + priority scatter stay XLA ops);
         a kernel COMPILE failure on the first dispatch degrades to the
         scan path exactly like run_sample_chunk."""
-        storage, size, priorities, maxp = device_replay.per_state()
-        args = (
-            np.float32(beta), np.float32(device_replay.alpha),
-            np.float32(device_replay.eps),
-        )
-        try:
-            out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
-                self.state, self._key, storage, size, priorities, maxp, *args
+        with _ingest_lock(device_replay):
+            storage, size, priorities, maxp = device_replay.per_state()
+            args = (
+                np.float32(beta), np.float32(device_replay.alpha),
+                np.float32(device_replay.eps),
             )
-        except Exception as e:
-            retryable = (
-                self.fused_per_active
-                and self.config.fused_chunk == "auto"
-                and not self._per_chunk_compiled
-                and not any(
-                    getattr(leaf, "is_deleted", lambda: False)()
-                    for leaf in jax.tree.leaves(
-                        (self.state, self._key, priorities)
+            try:
+                out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
+                    self.state, self._key, storage, size, priorities, maxp, *args
+                )
+            except Exception as e:
+                retryable = (
+                    self.fused_per_active
+                    and self.config.fused_chunk == "auto"
+                    and not self._per_chunk_compiled
+                    and not any(
+                        getattr(leaf, "is_deleted", lambda: False)()
+                        for leaf in jax.tree.leaves(
+                            (self.state, self._key, priorities)
+                        )
                     )
                 )
-            )
-            if not retryable:
-                raise
-            import warnings
+                if not retryable:
+                    raise
+                import warnings
 
-            warnings.warn(
-                "fused_chunk='auto': PER megakernel failed on this backend; "
-                f"falling back to the XLA scan path: {e!r}"
-            )
-            self.fused_chunk_error = repr(e)[:800]
-            self.fused_per_active = False
-            # Same kernel program backs the uniform variant — don't re-fail.
-            self.fused_chunk_active = False
-            self._sample_chunk_step = self._scan_sample_chunk_step
-            self._per_sample_chunk_step = self._scan_per_sample_chunk_step
-            out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
-                self.state, self._key, storage, size, priorities, maxp, *args
-            )
-        self._per_chunk_compiled = True
-        self.state = out.state
-        device_replay.set_per_state(new_p, new_maxp)
-        return out
+                warnings.warn(
+                    "fused_chunk='auto': PER megakernel failed on this backend; "
+                    f"falling back to the XLA scan path: {e!r}"
+                )
+                self.fused_chunk_error = repr(e)[:800]
+                self.fused_per_active = False
+                # Same kernel program backs the uniform variant — don't re-fail.
+                self.fused_chunk_active = False
+                self._sample_chunk_step = self._scan_sample_chunk_step
+                self._per_sample_chunk_step = self._scan_per_sample_chunk_step
+                out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
+                    self.state, self._key, storage, size, priorities, maxp, *args
+                )
+            self._per_chunk_compiled = True
+            self.state = out.state
+            device_replay.set_per_state(new_p, new_maxp)
+            return out
 
     # --- host-side views ---
 

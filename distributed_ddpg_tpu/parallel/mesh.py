@@ -29,6 +29,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_ddpg_tpu.types import Batch, OptState, TrainState
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check: bool = False):
+    """Version-portable shard_map: jax >= 0.6 exposes `jax.shard_map` with
+    `check_vma`; older jaxes (0.4.x here) only have
+    `jax.experimental.shard_map.shard_map` with the equivalent flag spelled
+    `check_rep`. Same semantics either way — per-shard body, explicit
+    collectives, specs name this module's (data, model) axes."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check
+    )
+
+
 def make_mesh(
     data_axis: int = -1,
     model_axis: int = 1,

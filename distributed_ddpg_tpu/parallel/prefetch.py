@@ -15,9 +15,18 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import warnings
 from typing import Dict, Optional
 
 import numpy as np
+
+
+class PrefetchTimeout(RuntimeError):
+    """next() deadline expired with the worker thread still alive — replay
+    starvation or a wedged device transfer, NOT a worker crash (a dead
+    worker surfaces as 'prefetch thread died' with its real exception
+    chained). Named so callers can distinguish a stall from the bare
+    queue.Empty internals."""
 
 
 class ChunkPrefetcher:
@@ -58,6 +67,12 @@ class ChunkPrefetcher:
             while not self._stop.is_set():
                 chunk = self._sample_chunk()
                 indices = chunk.pop("indices")
+                # Re-check stop BEFORE committing to the device transfer:
+                # put_chunk blocks on h2d (unboundedly, on a wedged
+                # tunnel), and a stop() issued while we sampled must not
+                # strand the join behind a transfer nobody will consume.
+                if self._stop.is_set():
+                    return
                 device_chunk = self._put(chunk)
                 # Block here (not in get()) when the queue is full — this is
                 # the backpressure that makes `depth` the buffer bound.
@@ -73,7 +88,8 @@ class ChunkPrefetcher:
     def next(self, timeout: float = 60.0):
         """Returns (device_chunk, host_indices[K, B]). Re-checks for a dead
         worker while waiting so its real exception surfaces promptly instead
-        of an unrelated queue timeout."""
+        of an unrelated queue timeout; a deadline with the worker ALIVE
+        raises PrefetchTimeout (named), never a bare queue.Empty."""
         deadline = time.monotonic() + timeout
         while True:
             if self._exc is not None:
@@ -82,14 +98,35 @@ class ChunkPrefetcher:
                 return self._q.get(timeout=min(0.5, max(0.0, deadline - time.monotonic())))
             except queue.Empty:
                 if time.monotonic() >= deadline:
-                    raise
+                    raise PrefetchTimeout(
+                        f"no prefetched chunk within {timeout:.1f}s with the "
+                        "worker alive — replay starvation or a wedged "
+                        "device transfer"
+                    ) from None
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> bool:
+        """Stop the worker and join it. Drains the queue REPEATEDLY while
+        joining: a worker blocked in q.put refills the single slot a
+        one-shot drain frees, and a worker blocked inside put_chunk's
+        device transfer may surface one more chunk before seeing the stop
+        flag. Returns False (with a warning) if the worker is still alive
+        at the deadline — it can only be wedged inside an uninterruptible
+        device transfer; the daemon thread is leaked rather than hanging
+        teardown forever."""
         self._stop.set()
-        # Drain so the worker unblocks from a full queue, then join.
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5.0)
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+        if self._thread.is_alive():
+            warnings.warn(
+                "prefetch worker did not exit within "
+                f"{timeout:.1f}s (blocked in a device transfer?); leaking "
+                "the daemon thread"
+            )
+            return False
+        return True

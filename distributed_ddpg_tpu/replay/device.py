@@ -18,6 +18,19 @@ packed [capacity, D] ring in device memory:
 
 ptr/size/PRNG key live on device; nothing round-trips.
 
+Ingest pipeline (docs/INGEST.md): pending actor rows stage in a
+preallocated host ring (replay/staging.py — one memcpy per push, killing
+the seed's O(n^2) np.concatenate), ship as COALESCED super-blocks (up to
+max_coalesce staged blocks fold into one device_put + one jitted scatter
+per device call, power-of-two group sizes so the compiled-insert cache
+stays O(log max_coalesce)), and — single-process, async_ship=True — move
+on a background shipper thread so dispatch overlaps learner compute. The
+coalesced scatter writes rows at exactly the positions the seed's serial
+one-block-at-a-time sequence would have (multi-host groups are transposed
+on device to interleave per-process blocks the way serial shipping did),
+so storage/ptr/size stay bit-identical — tests/test_ingest_pipeline.py
+and the multihost harness assert it.
+
 Multi-host: storage is replicated over the (possibly process-spanning)
 mesh, so every process must execute the IDENTICAL insert sequence on the
 identical global block — per-process-local inserts would silently fork the
@@ -33,6 +46,8 @@ Single-process keeps the inline fast path; sync_ship degrades to flush.
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import partial
 from typing import Optional
 
@@ -41,7 +56,60 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_ddpg_tpu.metrics import IngestStats
+from distributed_ddpg_tpu.replay.staging import HostStagingRing
 from distributed_ddpg_tpu.types import packed_width
+
+
+class IngestError(RuntimeError):
+    """The background ingest shipper thread died; the original exception
+    rides along as __cause__ (mirrors ChunkPrefetcher's 'prefetch thread
+    died' surfacing discipline)."""
+
+
+class _IngestShipper:
+    """Single-process background shipper: moves staged full blocks to HBM
+    off the producer's critical path, mirroring ChunkPrefetcher's
+    daemon-thread discipline. The bounded double buffer is the staging
+    ring itself: a full ring blocks producers inside add_packed (stall
+    time is counted in IngestStats), which is the backpressure that keeps
+    host memory bounded while dispatch overlaps learner compute."""
+
+    def __init__(self, replay: "DeviceReplay"):
+        self._replay = replay
+        self._stop = threading.Event()
+        self.exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ingest-ship"
+        )
+
+    def start(self) -> "_IngestShipper":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        r = self._replay
+        try:
+            while not self._stop.is_set():
+                with r._staging:
+                    while (
+                        len(r._ring) < r.block_size
+                        and not self._stop.is_set()
+                    ):
+                        r._staging.wait(0.1)
+                if self._stop.is_set():
+                    return
+                r._drain_ring()
+        except BaseException as e:  # surface in the producer's next call
+            self.exc = e
+            with r._staging:
+                r._staging.notify_all()  # unblock backpressure waiters
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._replay._staging:
+            self._replay._staging.notify_all()
+        self._thread.join(timeout=timeout)
 
 
 class DeviceReplay:
@@ -53,6 +121,9 @@ class DeviceReplay:
         mesh: Optional[Mesh] = None,
         block_size: int = 4096,
         seed: int = 0,
+        async_ship: bool = False,
+        max_coalesce: int = 8,
+        staging_blocks: int = 16,
     ):
         self.capacity = int(capacity)
         self.obs_dim = obs_dim
@@ -71,7 +142,21 @@ class DeviceReplay:
             self.storage = jax.device_put(self.storage, sharding)
             self.ptr = jax.device_put(self.ptr, scalar_sharding)
             self.size = jax.device_put(self.size, scalar_sharding)
-        self._pending = np.zeros((0, self.width), np.float32)
+
+        # --- ingest pipeline state (docs/INGEST.md) ---
+        # Staging ring + condition: producers push under it, the shipper /
+        # sync paths pop under it, and backpressure waits on it. The
+        # dispatch lock serializes every device-op sequence that reads or
+        # swaps storage/ptr/size (ship calls here, chunk dispatch in
+        # parallel/learner.py) so a donated-away storage buffer is never
+        # observable mid-swap from another thread.
+        self._max_coalesce = max(1, int(max_coalesce))
+        self._ring = HostStagingRing(
+            self.width, max(1, int(staging_blocks)) * self.block_size
+        )
+        self._staging = threading.Condition()
+        self.dispatch_lock = threading.RLock()
+        self._stats = IngestStats()
 
         donate = partial(
             jax.jit,
@@ -94,6 +179,9 @@ class DeviceReplay:
             new_size = jnp.minimum(size + m, self.capacity)
             return storage, new_ptr, new_size
 
+        # One jitted program per super-block shape; shapes are restricted
+        # to power-of-two multiples of block_size (_coalesce_k), so the
+        # jit cache holds at most log2(max_coalesce)+1 entries.
         self._insert = donate(_insert_impl)
 
         # Multi-host ingest (see module docstring): a second compiled insert
@@ -108,14 +196,18 @@ class DeviceReplay:
                     f"must divide evenly over data axis {mesh.shape['data']}"
                 )
             self._block_sharding = NamedSharding(mesh, P("data", None))
-            self._insert_global = jax.jit(
-                _insert_impl,
-                donate_argnums=(0,),
-                in_shardings=(
-                    sharding, self._block_sharding, scalar_sharding, scalar_sharding
-                ),
-                out_shardings=(sharding, scalar_sharding, scalar_sharding),
+            self._global_in_shardings = (
+                sharding, self._block_sharding, scalar_sharding, scalar_sharding
             )
+            self._global_out_shardings = (
+                sharding, scalar_sharding, scalar_sharding
+            )
+            self._insert_global_cache = {}
+
+        # Background shipper (single-process only: multi-host rows may
+        # leave the host ONLY via the lockstep sync_ship collective).
+        self._async = bool(async_ship) and self._procs == 1
+        self._shipper = _IngestShipper(self).start() if self._async else None
 
     def __len__(self) -> int:
         return int(jax.device_get(self.size))
@@ -126,52 +218,149 @@ class DeviceReplay:
         discount==0 marks terminal transitions, whose one-off rewards must
         not enter the persistent-reward bound).
         One bounded d2h outside the hot loop. Multi-process: REPLICATED
-        storage only — _pending holds process-LOCAL un-shipped rows, and
-        per-process bounds derived from them would compile different
-        Bellman targets per replica (the replica fork this module's insert
-        discipline exists to prevent). Single-process includes _pending so
-        a just-warmed buffer is fully represented."""
+        storage only — the staging ring holds process-LOCAL un-shipped
+        rows, and per-process bounds derived from them would compile
+        different Bellman targets per replica (the replica fork this
+        module's insert discipline exists to prevent). Single-process
+        includes staged rows so a just-warmed buffer is fully
+        represented."""
         col = self.obs_dim + self.act_dim
-        size = len(self)
-        n = min(size, max_n)
-        if n == size:
-            cols = np.asarray(jax.device_get(self.storage[:n, col : col + 2]))
-        else:
-            # Evenly strided over the live region, not the [:n] prefix —
-            # a 1M-ring prefix can be ~900k insertions stale, and the
-            # round-5 corroboration gate would refuse legitimate
-            # expansions against long-gone rewards. Deterministic stride:
-            # replicas and strict_sync replays see identical samples.
-            idx = np.linspace(0, size - 1, n).astype(np.int64)
-            cols = np.asarray(
-                jax.device_get(jnp.take(self.storage[:, col : col + 2],
-                                        jnp.asarray(idx), axis=0))
-            )
-        if self._procs == 1 and len(self._pending):
-            cols = np.concatenate([cols, self._pending[:max_n, col : col + 2]])
+        # dispatch_lock: the async shipper's insert DONATES storage, so an
+        # unlocked read here could dispatch against a deleted buffer.
+        with self.dispatch_lock:
+            size = len(self)
+            n = min(size, max_n)
+            if n == size:
+                cols = np.asarray(
+                    jax.device_get(self.storage[:n, col : col + 2])
+                )
+            else:
+                # Evenly strided over the live region, not the [:n] prefix
+                # — a 1M-ring prefix can be ~900k insertions stale, and the
+                # round-5 corroboration gate would refuse legitimate
+                # expansions against long-gone rewards. Deterministic
+                # stride: replicas and strict_sync replays see identical
+                # samples.
+                idx = np.linspace(0, size - 1, n).astype(np.int64)
+                cols = np.asarray(
+                    jax.device_get(jnp.take(self.storage[:, col : col + 2],
+                                            jnp.asarray(idx), axis=0))
+                )
+        if self._procs == 1:
+            with self._staging:
+                pend = self._ring.peek_cols(col, 2, max_n)
+            if len(pend):
+                cols = np.concatenate([cols, pend])
         return cols[:, 0], cols[:, 1]
 
     @property
     def pending_rows(self) -> int:
-        """Host-side rows buffered but not yet shipped (multi-host: waiting
+        """Host-side rows staged but not yet shipped (multi-host: waiting
         for the lockstep sync_ship; callers use this for backpressure)."""
-        return len(self._pending)
+        with self._staging:
+            return len(self._ring)
+
+    def ingest_snapshot(self) -> dict:
+        """Interval ingest observability fields (metrics.IngestStats):
+        rows/sec shipped, ship calls, coalesce factor, producer stall
+        time, queue depth — emitted into train/bench records."""
+        return self._stats.snapshot(pending_rows=self.pending_rows)
+
+    def close(self) -> None:
+        """Stop the background shipper (if any); subsequent add_packed
+        calls fall back to inline shipping, so teardown stragglers still
+        land."""
+        if self._shipper is not None:
+            self._shipper.stop()
+            self._shipper = None
+            self._async = False
 
     # --- host -> HBM ingestion ---
 
+    def _check_shipper(self) -> None:
+        s = self._shipper
+        if s is not None and s.exc is not None:
+            raise IngestError("ingest shipper thread died") from s.exc
+
+    def _coalesce_k(self, n_blocks: int, cap_blocks: int) -> int:
+        """Blocks to fold into the next super-block ship: largest power of
+        two <= min(staged, max_coalesce, capacity) — capacity-capped so
+        every scatter index within one super-block is distinct, which is
+        what makes the coalesced scatter equal the serial sequence."""
+        k = min(n_blocks, self._max_coalesce, max(1, cap_blocks))
+        if k <= 0:
+            return 0
+        return 1 << (k.bit_length() - 1)
+
+    def _drain_ring(self) -> int:
+        """Ship every currently-staged FULL block, coalesced. Called
+        inline (sync mode), from the shipper thread (async mode), and from
+        flush/sync_ship/drain_pending — all pops happen under the dispatch
+        lock so the pop -> device-op order is the ring's FIFO order no
+        matter which thread ships."""
+        shipped = 0
+        cap_blocks = self.capacity // self.block_size
+        while True:
+            with self.dispatch_lock:
+                with self._staging:
+                    k = self._coalesce_k(
+                        len(self._ring) // self.block_size, cap_blocks
+                    )
+                    if k == 0:
+                        return shipped
+                    rows = self._ring.pop(k * self.block_size)
+                    self._staging.notify_all()
+                t0 = time.perf_counter()
+                self._ship(rows)
+                self._stats.record_ship(
+                    len(rows), k, time.perf_counter() - t0
+                )
+            shipped += k * self.block_size
+
     def add_packed(self, block: np.ndarray) -> None:
-        """Buffer packed [M, D] rows host-side; ship in fixed-size blocks
-        (fixed shapes -> one compiled insert, no retrace churn). Multi-host:
-        buffers ONLY — rows leave via the lockstep sync_ship()."""
-        self._pending = np.concatenate([self._pending, block.astype(np.float32)])
-        if self._procs > 1:
+        """Stage packed [M, D] rows in the host ring; ship in fixed-size
+        blocks (fixed power-of-two super-block shapes -> a bounded set of
+        compiled inserts, no retrace churn). Multi-host: stages ONLY —
+        rows leave via the lockstep sync_ship(). async_ship mode: the
+        shipper thread does the device work; a full ring blocks here
+        (backpressure, counted as ingest_stall_ms)."""
+        self._check_shipper()
+        rows = np.asarray(block, np.float32)
+        stall = 0.0
+        with self._staging:
+            if self._async:
+                t0 = time.perf_counter()
+                while (
+                    len(self._ring) + len(rows) > self._ring.capacity
+                    and len(self._ring) >= self.block_size
+                ):
+                    self._staging.wait(0.05)
+                    self._check_shipper()
+                    if not self._async:
+                        # close() raced us: nothing will drain the ring;
+                        # fall through to push (the ring grows) and the
+                        # inline ship below.
+                        break
+                stall = time.perf_counter() - t0
+            self._ring.push(rows)
+            self._stats.record_push(len(rows), stall)
+            self._staging.notify_all()
+        if self._procs > 1 or self._async:
             return
-        while len(self._pending) >= self.block_size:
-            chunk, self._pending = (
-                self._pending[: self.block_size],
-                self._pending[self.block_size :],
-            )
-            self._ship(chunk)
+        self._drain_ring()
+
+    def drain_pending(self) -> int:
+        """Ship all staged full blocks and block until the inserts have
+        executed — the barrier bench/tests use before reading storage.
+        Single-process only (multi-host draining IS sync_ship)."""
+        if self._procs > 1:
+            raise RuntimeError("drain_pending() is per-process; use "
+                               "sync_ship() in multi-host runs")
+        self._check_shipper()
+        moved = self._drain_ring()
+        with self.dispatch_lock:  # donation safety: see reward_sample
+            jax.block_until_ready(self.storage)
+        return moved
 
     def flush(self, min_rows: int = 1) -> None:
         """Force pending rows out (padded by repetition to the block shape —
@@ -181,67 +370,124 @@ class DeviceReplay:
         if self._procs > 1:
             raise RuntimeError("flush() is per-process; use sync_ship() "
                                "in multi-host runs")
-        n = len(self._pending)
-        if n >= min_rows and n > 0:
-            reps = -(-self.block_size // n)
-            chunk = np.tile(self._pending, (reps, 1))[: self.block_size]
-            self._pending = np.zeros((0, self.width), np.float32)
-            self._ship(chunk)
+        self._check_shipper()
+        self._drain_ring()
+        with self.dispatch_lock:
+            with self._staging:
+                n = len(self._ring)
+                rows = self._ring.pop(n) if (n >= min_rows and n > 0) else None
+                if rows is not None:
+                    self._staging.notify_all()
+            if rows is not None:
+                reps = -(-self.block_size // n)
+                chunk = np.tile(rows, (reps, 1))[: self.block_size]
+                t0 = time.perf_counter()
+                self._ship(chunk)
+                self._stats.record_ship(n, 1, time.perf_counter() - t0)
 
     def sync_ship(self, force: bool = False) -> int:
         """Multi-host-safe ingest step. ALL processes must call this at the
         same point in their loop (train_jax: once per learner chunk) — it
         all-gathers pending counts and ships exactly min-over-processes
         full blocks, so every process executes the identical sequence of
-        global device ops on a consistently-sharded block.
+        global device ops on a consistently-sharded block. Full blocks are
+        coalesced into power-of-two super-blocks (identical k sequence on
+        every process — it derives from the all-gathered min), each landed
+        by ONE all-gathering insert whose on-device transpose reproduces
+        the serial per-block interleave exactly.
 
         force=True additionally pads one block from the remainders (only
         when every process holds >= 1 pending row) — warmup/shutdown use.
         Returns locally shipped real (unpadded) rows. Single-process it
         degrades to the add_packed/flush fast path."""
         if self._procs == 1:
-            moved = 0
-            while len(self._pending) >= self.block_size:
-                chunk, self._pending = (
-                    self._pending[: self.block_size],
-                    self._pending[self.block_size :],
-                )
-                self._ship(chunk)
-                moved += self.block_size
-            if force and len(self._pending):
-                moved += len(self._pending)
+            self._check_shipper()
+            moved = self._drain_ring()
+            if force and self.pending_rows:
+                moved += self.pending_rows
                 self.flush()
             return moved
 
         from jax.experimental import multihost_utils
 
         counts = np.asarray(
-            multihost_utils.process_allgather(np.int32(len(self._pending)))
+            multihost_utils.process_allgather(np.int32(self.pending_rows))
         )
         m = int(counts.min())
         moved = 0
-        for _ in range(m // self.block_size):
-            chunk, self._pending = (
-                self._pending[: self.block_size],
-                self._pending[self.block_size :],
-            )
-            self._ship_global(chunk)
-            moved += self.block_size
-        if force and m % self.block_size:
-            take = min(len(self._pending), self.block_size)
-            chunk, self._pending = self._pending[:take], self._pending[take:]
-            reps = -(-self.block_size // take)
-            self._ship_global(np.tile(chunk, (reps, 1))[: self.block_size])
-            moved += take
+        cap_blocks = self.capacity // (self._procs * self.block_size)
+        remaining = m // self.block_size
+        with self.dispatch_lock:
+            while remaining:
+                k = self._coalesce_k(remaining, cap_blocks)
+                with self._staging:
+                    rows = self._ring.pop(k * self.block_size)
+                t0 = time.perf_counter()
+                self._ship_global(rows, k=k)
+                self._stats.record_ship(
+                    k * self.block_size, k, time.perf_counter() - t0
+                )
+                moved += k * self.block_size
+                remaining -= k
+            if force and m % self.block_size:
+                take = min(self.pending_rows, self.block_size)
+                with self._staging:
+                    rows = self._ring.pop(take)
+                reps = -(-self.block_size // take)
+                t0 = time.perf_counter()
+                self._ship_global(np.tile(rows, (reps, 1))[: self.block_size])
+                self._stats.record_ship(take, 1, time.perf_counter() - t0)
+                moved += take
         return moved
 
-    def _ship_global(self, local_rows: np.ndarray) -> None:
+    def _get_global_insert(self, k: int):
+        """Compiled all-gathering insert for a k-block super-block. The
+        global array arrives ordered [proc0's k blocks | proc1's k blocks
+        | ...] (data-axis shard order); serial shipping would have landed
+        it block-by-block as [b0p0 b0p1 ... | b1p0 b1p1 ...]. Rather than
+        transposing the SHARDED operand (a resharding XLA's multiprocess
+        CPU backend refuses to compile), the scatter INDICES are permuted:
+        gathered row g = (p, j, r) writes at ptr + j*(procs*bs) + p*bs + r
+        — pure elementwise iota math, same all-gather + local scatter
+        structure as k=1, and the storage layout stays bit-identical to
+        the seed's serial sequence. Cached per k (power-of-two set, so
+        O(log max_coalesce) programs)."""
+        fn = self._insert_global_cache.get(k)
+        if fn is None:
+            procs, bs = self._procs, self.block_size
+
+            def impl(storage, block, ptr, size):
+                m = block.shape[0]  # procs * k * bs
+                g = jnp.arange(m, dtype=jnp.int32)
+                if k > 1:
+                    p = g // (k * bs)
+                    j = (g % (k * bs)) // bs
+                    r = g % bs
+                    offset = j * (procs * bs) + p * bs + r
+                else:
+                    offset = g
+                idx = (ptr + offset) % self.capacity
+                storage = storage.at[idx].set(block)
+                new_ptr = (ptr + m) % self.capacity
+                new_size = jnp.minimum(size + m, self.capacity)
+                return storage, new_ptr, new_size
+
+            fn = jax.jit(
+                impl,
+                donate_argnums=(0,),
+                in_shardings=self._global_in_shardings,
+                out_shardings=self._global_out_shardings,
+            )
+            self._insert_global_cache[k] = fn
+        return fn
+
+    def _ship_global(self, local_rows: np.ndarray, k: int = 1) -> None:
         block = jax.make_array_from_process_local_data(
             self._block_sharding,
             np.ascontiguousarray(local_rows, np.float32),
-            (self._procs * self.block_size, self.width),
+            (self._procs * k * self.block_size, self.width),
         )
-        self.storage, self.ptr, self.size = self._insert_global(
+        self.storage, self.ptr, self.size = self._get_global_insert(k)(
             self.storage, block, self.ptr, self.size
         )
 
@@ -262,34 +508,36 @@ class DeviceReplay:
     # --- checkpoint support (same contract as host buffers) ---
 
     def state_dict(self):
-        n = len(self)
-        storage = np.asarray(jax.device_get(self.storage))
-        return {
-            "packed": storage[:n].copy(),
-            "ptr": np.asarray(int(jax.device_get(self.ptr))),
-            "size": np.asarray(n),
-        }
+        with self.dispatch_lock:
+            n = len(self)
+            storage = np.asarray(jax.device_get(self.storage))
+            return {
+                "packed": storage[:n].copy(),
+                "ptr": np.asarray(int(jax.device_get(self.ptr))),
+                "size": np.asarray(n),
+            }
 
     def load_state_dict(self, state) -> None:
         n = int(state["size"])
         if n > self.capacity:
             raise ValueError(f"checkpointed size {n} exceeds capacity {self.capacity}")
-        storage = np.array(jax.device_get(self.storage))  # writable copy
-        storage[:n] = state["packed"]
-        sharding = (
-            NamedSharding(self._mesh, P(None, None)) if self._mesh is not None else None
-        )
-        self.storage = (
-            jax.device_put(jnp.asarray(storage), sharding)
-            if sharding is not None
-            else jnp.asarray(storage)
-        )
-        self.ptr = jnp.asarray(int(state["ptr"]) % self.capacity, jnp.int32)
-        self.size = jnp.asarray(n, jnp.int32)
-        if self._mesh is not None:
-            scalar = NamedSharding(self._mesh, P())
-            self.ptr = jax.device_put(self.ptr, scalar)
-            self.size = jax.device_put(self.size, scalar)
+        with self.dispatch_lock:
+            storage = np.array(jax.device_get(self.storage))  # writable copy
+            storage[:n] = state["packed"]
+            sharding = (
+                NamedSharding(self._mesh, P(None, None)) if self._mesh is not None else None
+            )
+            self.storage = (
+                jax.device_put(jnp.asarray(storage), sharding)
+                if sharding is not None
+                else jnp.asarray(storage)
+            )
+            self.ptr = jnp.asarray(int(state["ptr"]) % self.capacity, jnp.int32)
+            self.size = jnp.asarray(n, jnp.int32)
+            if self._mesh is not None:
+                scalar = NamedSharding(self._mesh, P())
+                self.ptr = jax.device_put(self.ptr, scalar)
+                self.size = jax.device_put(self.size, scalar)
 
 
 def draw_per_indices(key, priorities, size, shape, beta):
@@ -338,6 +586,10 @@ class DevicePrioritizedReplay(DeviceReplay):
         indices at chunk end — the same once-per-chunk cadence the host
         path has (update_priorities is called once per after_chunk).
 
+    Coalesced ingest stamps the whole super-block from the pre-insert ptr
+    with the current max priority — exactly what k serial stamps with the
+    same (learner-updated-only) max would do, so parity holds.
+
     Multi-host: priorities/max_priority are replicated like storage, and
     every update is computed from replicated inputs (state, key, td), so
     replicas stay identical with no extra collectives."""
@@ -352,20 +604,29 @@ class DevicePrioritizedReplay(DeviceReplay):
         seed: int = 0,
         alpha: float = 0.6,
         eps: float = 1e-6,
+        **kwargs,
     ):
         super().__init__(capacity, obs_dim, act_dim, mesh=mesh,
-                         block_size=block_size, seed=seed)
+                         block_size=block_size, seed=seed, **kwargs)
         self.alpha = float(alpha)
         self.eps = float(eps)
         vec_sharding = NamedSharding(mesh, P(None)) if mesh is not None else None
         scalar_sharding = NamedSharding(mesh, P()) if mesh is not None else None
+        self._stamp_shardings = (vec_sharding, scalar_sharding)
         self.priorities = jnp.zeros((self.capacity,), jnp.float32)
         self.max_priority = jnp.ones((), jnp.float32)
         if vec_sharding is not None:
             self.priorities = jax.device_put(self.priorities, vec_sharding)
             self.max_priority = jax.device_put(self.max_priority, scalar_sharding)
+        # One stamp program per super-block row count m (power-of-two
+        # multiples of block_size, same bounded set as the inserts).
+        self._stamp_cache = {}
 
-        def make_stamp(m: int):
+    def _get_stamp(self, m: int):
+        fn = self._stamp_cache.get(m)
+        if fn is None:
+            vec_sharding, scalar_sharding = self._stamp_shardings
+
             def stamp(prios, maxp, old_ptr):
                 idx = (old_ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
                 return prios.at[idx].set(maxp)
@@ -378,23 +639,21 @@ class DevicePrioritizedReplay(DeviceReplay):
                 if vec_sharding is not None
                 else {}
             )
-            return jax.jit(stamp, donate_argnums=(0,), **kwargs)
-
-        self._stamp_local = make_stamp(self.block_size)
-        if self._procs > 1:
-            self._stamp_global = make_stamp(self._procs * self.block_size)
+            fn = jax.jit(stamp, donate_argnums=(0,), **kwargs)
+            self._stamp_cache[m] = fn
+        return fn
 
     def _ship(self, chunk: np.ndarray) -> None:
         old_ptr = self.ptr  # not donated by _insert; still valid after
         super()._ship(chunk)
-        self.priorities = self._stamp_local(
+        self.priorities = self._get_stamp(len(chunk))(
             self.priorities, self.max_priority, old_ptr
         )
 
-    def _ship_global(self, local_rows: np.ndarray) -> None:
+    def _ship_global(self, local_rows: np.ndarray, k: int = 1) -> None:
         old_ptr = self.ptr
-        super()._ship_global(local_rows)
-        self.priorities = self._stamp_global(
+        super()._ship_global(local_rows, k=k)
+        self.priorities = self._get_stamp(self._procs * k * self.block_size)(
             self.priorities, self.max_priority, old_ptr
         )
 
@@ -405,38 +664,44 @@ class DevicePrioritizedReplay(DeviceReplay):
 
     def set_per_state(self, priorities, max_priority) -> None:
         """Install the updated priority vector returned by the learner's
-        fused chunk (both already carry the replicated sharding)."""
+        fused chunk (both already carry the replicated sharding). Callers
+        must hold dispatch_lock across per_state -> dispatch ->
+        set_per_state (parallel/learner.py does) — otherwise a concurrent
+        shipper stamp between the read and this write would be lost and
+        freshly-inserted rows would keep priority 0 forever."""
         self.priorities = priorities
         self.max_priority = max_priority
 
     # --- checkpoint support ---
 
     def state_dict(self):
-        state = super().state_dict()
-        n = int(state["size"])
-        prios = np.asarray(jax.device_get(self.priorities))
-        state["priorities"] = prios[:n].copy()
-        state["max_priority"] = np.asarray(
-            float(jax.device_get(self.max_priority))
-        )
-        return state
+        with self.dispatch_lock:
+            state = super().state_dict()
+            n = int(state["size"])
+            prios = np.asarray(jax.device_get(self.priorities))
+            state["priorities"] = prios[:n].copy()
+            state["max_priority"] = np.asarray(
+                float(jax.device_get(self.max_priority))
+            )
+            return state
 
     def load_state_dict(self, state) -> None:
-        super().load_state_dict(state)
-        if "priorities" in state:
-            n = int(state["size"])
-            prios = np.array(jax.device_get(self.priorities))
-            prios[:n] = state["priorities"]
-            vec_sharding = (
-                NamedSharding(self._mesh, P(None)) if self._mesh is not None else None
-            )
-            scalar = (
-                NamedSharding(self._mesh, P()) if self._mesh is not None else None
-            )
-            self.priorities = jnp.asarray(prios)
-            self.max_priority = jnp.asarray(
-                float(state["max_priority"]), jnp.float32
-            )
-            if vec_sharding is not None:
-                self.priorities = jax.device_put(self.priorities, vec_sharding)
-                self.max_priority = jax.device_put(self.max_priority, scalar)
+        with self.dispatch_lock:
+            super().load_state_dict(state)
+            if "priorities" in state:
+                n = int(state["size"])
+                prios = np.array(jax.device_get(self.priorities))
+                prios[:n] = state["priorities"]
+                vec_sharding = (
+                    NamedSharding(self._mesh, P(None)) if self._mesh is not None else None
+                )
+                scalar = (
+                    NamedSharding(self._mesh, P()) if self._mesh is not None else None
+                )
+                self.priorities = jnp.asarray(prios)
+                self.max_priority = jnp.asarray(
+                    float(state["max_priority"]), jnp.float32
+                )
+                if vec_sharding is not None:
+                    self.priorities = jax.device_put(self.priorities, vec_sharding)
+                    self.max_priority = jax.device_put(self.max_priority, scalar)

@@ -436,7 +436,19 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # buffer + prefetch pipeline — the fallback for buffers beyond HBM.
     use_device_replay = not config.host_replay
     if use_device_replay:
-        replay_kwargs = dict(mesh=learner.mesh, block_size=1024)
+        # Async ingest shipping (docs/INGEST.md): single-process only
+        # (multi-host rows leave via the lockstep sync_ship collective)
+        # and never under strict_sync — the shipper thread would make
+        # row-landing timing (hence the sampled stream) a function of
+        # host scheduling instead of the config.
+        replay_kwargs = dict(
+            mesh=learner.mesh,
+            block_size=1024,
+            async_ship=(
+                config.ingest_async and not is_multi and not config.strict_sync
+            ),
+            max_coalesce=config.ingest_coalesce,
+        )
         device_replay = (
             DevicePrioritizedReplay(
                 config.replay_capacity, spec.obs_dim, spec.act_dim,
@@ -611,7 +623,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         only — the UNCONDITIONAL lockstep sync_ship collective. Every site
         that ingests on the hot path must go through here: the drain gate
         uses process-LOCAL counters, so the collective must not be skippable
-        on some processes (replay/device.py sync_ship)."""
+        on some processes (replay/device.py sync_ship). Single-process,
+        add_packed only stages into the host ring when the async shipper is
+        on — the device work happens off this thread (docs/INGEST.md)."""
         with phases.phase("ingest"):
             moved = drain()
             env_timer.tick(moved)
@@ -747,6 +761,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 **chunk_metrics,
                 **support_metrics,
                 **phases.snapshot(),
+                # Ingest pipeline observability (replay/device.py
+                # IngestStats): rows/sec shipped to HBM, coalesce factor,
+                # staging-queue depth, producer stall time.
+                **(
+                    device_replay.ingest_snapshot()
+                    if use_device_replay
+                    else {}
+                ),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -828,7 +850,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 use_device_replay
                 and not is_multi
                 and moved
-                and buffer_fill() + len(device_replay._pending) >= min_fill
+                and buffer_fill() + device_replay.pending_rows >= min_fill
             ):
                 device_replay.flush()
             if moved:
@@ -977,6 +999,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         _beat()  # each teardown stage gets a fresh watchdog allowance
         pool.stop()
         _beat()
+        if use_device_replay and device_replay is not None:
+            # Stop the async ingest shipper; add_packed falls back to
+            # inline shipping for any teardown stragglers.
+            device_replay.close()
         # Land the in-flight checkpoint write (and surface its error, if
         # any) before callers read the directory back.
         saver.wait()

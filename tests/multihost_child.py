@@ -20,6 +20,11 @@ Usage: python multihost_child.py <process_id> <num_processes> <port> [mode]
                  local SGD) on a mesh that SPANS processes — the
                  chunk-boundary param pmean crosses the process boundary
                  (Gloo here, DCN on a pod); parity on the end state
+  mode = coalesce: coalesced lockstep sync_ship (super-block all-gather
+                 insert with the on-device per-process interleave
+                 transpose) vs the seed's serial max_coalesce=1 sequence
+                 in the SAME cluster — storage/ptr/size must come out
+                 bit-identical (docs/INGEST.md)
 """
 
 import os
@@ -41,6 +46,15 @@ def main() -> None:
     os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
     os.environ["JAX_PROCESS_ID"] = str(pid)
 
+    # The multiprocess CPU backend needs an explicit collectives transport
+    # (the Gloo the module docstring's 'Gloo here, DCN on a pod' refers
+    # to): without it, cross-process computations fail with "Multiprocess
+    # computations aren't implemented on the CPU backend". Set before the
+    # backend is created, and only on the actual child path — gloo setup
+    # requires a distributed client, so a single-process import of this
+    # module (the parity oracle) must not inherit it.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
     from distributed_ddpg_tpu.parallel import multihost
 
     assert multihost.initialize() is True
@@ -57,6 +71,8 @@ def main() -> None:
         run_parity_chunk(ShardedLearner, DDPGConfig, np, tag=f"proc{pid}")
     elif mode == "replay":
         run_replay_parity(pid, nprocs, tag=f"proc{pid}")
+    elif mode == "coalesce":
+        run_coalesced_ingest_parity(pid, tag=f"proc{pid}")
     elif mode == "train":
         run_train_parity(tag=f"proc{pid}")
     elif mode == "fused":
@@ -113,6 +129,57 @@ def run_fused_mesh_parity(tag: str) -> None:
     leaves = jax.tree.leaves(jax.device_get(learner.state.actor_params))
     checksum = float(sum(np.abs(leaf).sum() for leaf in leaves))
     print(f"PARITY {tag} {loss:.8f}/{loss2:.8f} {checksum:.6f}", flush=True)
+
+
+def run_coalesced_ingest_parity(pid: int, tag: str) -> None:
+    """Two DeviceReplay instances in the SAME jax.distributed cluster, fed
+    identical per-process rows: `serial` ships with max_coalesce=1 (the
+    seed's exact one-global-block-per-collective sequence), `coal` with
+    max_coalesce=4 (super-block all-gather inserts whose on-device
+    transpose must reproduce the serial per-process block interleave).
+    Every process calls both replays' sync_ship at the same points, so the
+    collective schedule stays lockstep; the parity line carries a local
+    bit-identity verdict plus the coalesced storage checksum the parent
+    compares across processes (replica consistency)."""
+    import numpy as np
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+
+    obs_dim, act_dim = 5, 2
+    config = DDPGConfig(
+        actor_hidden=(16, 16), critic_hidden=(16, 16), batch_size=16, seed=0
+    )
+    learner = ShardedLearner(config, obs_dim, act_dim, action_scale=1.0,
+                             chunk_size=2)
+    serial = DeviceReplay(8192, obs_dim, act_dim, mesh=learner.mesh,
+                          block_size=128, max_coalesce=1)
+    coal = DeviceReplay(8192, obs_dim, act_dim, mesh=learner.mesh,
+                        block_size=128, max_coalesce=4)
+    r = np.random.default_rng(50 + pid)
+    # 5 full blocks (serial: 5 collectives; coal: one k=4 + one k=1) plus
+    # a 37-row remainder for the force-padded block.
+    rows = (0.1 * r.standard_normal((5 * 128 + 37, serial.width))).astype(
+        np.float32
+    )
+    for rep in (serial, coal):
+        rep.add_packed(rows.copy())
+        moved = rep.sync_ship()
+        moved += rep.sync_ship(force=True)
+        assert moved == len(rows), (moved, len(rows))
+
+    import jax
+
+    s0 = np.asarray(jax.device_get(serial.storage))
+    s1 = np.asarray(jax.device_get(coal.storage))
+    identical = bool(
+        np.array_equal(s0, s1)
+        and int(jax.device_get(serial.ptr)) == int(jax.device_get(coal.ptr))
+        and int(jax.device_get(serial.size)) == int(jax.device_get(coal.size))
+    )
+    checksum = float(np.abs(s1).sum())
+    print(f"PARITY {tag} {int(identical)} {checksum:.4f}", flush=True)
 
 
 def run_replay_parity(pid: int, nprocs: int, tag: str) -> None:

@@ -49,11 +49,11 @@ def test_pending_accumulates_until_block():
     rep = DeviceReplay(capacity=256, obs_dim=OBS, act_dim=ACT, mesh=mesh, block_size=64)
     rng = np.random.default_rng(1)
     rep.add_packed(_rows(rng, 30))
-    assert len(rep) == 0 and len(rep._pending) == 30
+    assert len(rep) == 0 and rep.pending_rows == 30
     rep.add_packed(_rows(rng, 40))   # 70 total -> one 64-block ships
-    assert len(rep) == 64 and len(rep._pending) == 6
+    assert len(rep) == 64 and rep.pending_rows == 6
     rep.flush()
-    assert len(rep) == 128 and len(rep._pending) == 0
+    assert len(rep) == 128 and rep.pending_rows == 0
 
 
 def test_fused_sampling_chunk():

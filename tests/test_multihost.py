@@ -109,6 +109,19 @@ def test_two_process_device_replay_ingest():
 
 
 @pytest.mark.slow
+def test_two_process_coalesced_ingest_parity():
+    """Coalesced lockstep ingest (docs/INGEST.md): the super-block
+    all-gather insert's on-device transpose must land rows at EXACTLY the
+    positions the seed's serial one-block-per-collective sequence does —
+    each child compares a serial and a coalesced replay bit-for-bit in the
+    same cluster, and the parent checks the replicas agree."""
+    (_, ok0, ck0), (_, ok1, ck1) = _run_pair("coalesce")
+    assert ok0 == "1", "coalesced storage != serial storage on proc0"
+    assert ok1 == "1", "coalesced storage != serial storage on proc1"
+    assert ck0 == ck1, f"replica checksum fork: {ck0} vs {ck1}"
+
+
+@pytest.mark.slow
 def test_two_process_fused_mesh_parity():
     """Megakernel x mesh (fused_mesh, K-step local SGD) on a {data:4} mesh
     spanning 2 processes: the chunk-boundary param pmean is a CROSS-PROCESS
