@@ -200,29 +200,34 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     out = learner.run_sample_chunk(device_replay)
     _ = float(out.metrics["critic_loss"])  # sync
 
+    # PhaseTimers (metrics.py): same bracket train_jax uses, so bench
+    # records carry the identical t_dispatch_ms/t_ingest_ms means PLUS
+    # the reservoir tails (p50/p95/max) — the 8-device ingest regression
+    # in BENCH_r05 hid behind a healthy mean.
+    from distributed_ddpg_tpu.metrics import PhaseTimers
+
+    phases = PhaseTimers()
     steps = 0
     ingested = 0.0
-    t_dispatch = t_ingest = 0.0
     dispatches = 0
     t0 = time.perf_counter()
     deadline = t0 + seconds
     while time.perf_counter() < deadline:
-        t1 = time.perf_counter()
-        out = learner.run_sample_chunk(device_replay)
-        t_dispatch += time.perf_counter() - t1
+        with phases.phase("dispatch"):
+            out = learner.run_sample_chunk(device_replay)
         dispatches += 1
         steps += chunk
         # Ship actor blocks at the modeled ingest rate.
-        t1 = time.perf_counter()
-        due = (t1 - t0) * actor_rate
-        while ingested + 4096 <= due:
-            device_replay.add_packed(ingest_rows)
-            ingested += 4096
-        t_ingest += time.perf_counter() - t1
+        with phases.phase("ingest"):
+            due = (time.perf_counter() - t0) * actor_rate
+            while ingested + 4096 <= due:
+                device_replay.add_packed(ingest_rows)
+                ingested += 4096
     _ = float(out.metrics["critic_loss"])  # sync on the last chunk
     elapsed = time.perf_counter() - t0
     rate = steps / elapsed
     ingest = device_replay.ingest_snapshot()
+    phase_fields = phases.snapshot()
     device_replay.close()
 
     dev = jax.devices()[0]
@@ -241,14 +246,14 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
             if learner.fused_chunk_error
             else {}
         ),
-        # Per-phase breakdown (SURVEY.md §5): mean chunk dispatch(+compute
-        # backpressure) time vs actor-ingest h2d time per loop iteration.
+        # Per-phase breakdown (SURVEY.md §5): mean + p50/p95/max chunk
+        # dispatch(+compute backpressure) time vs actor-ingest h2d time
+        # per loop iteration (PhaseTimers reservoir, metrics.py).
         # t_ingest_ms is the CALLER-VISIBLE (learner critical path) cost;
         # the ingest_* fields (metrics.IngestStats) describe what the
         # pipeline did off-path: rows/sec landed, blocks coalesced per
         # device call, producer stall on backpressure, queue depth.
-        "t_dispatch_ms": round(1000.0 * t_dispatch / max(dispatches, 1), 3),
-        "t_ingest_ms": round(1000.0 * t_ingest / max(dispatches, 1), 3),
+        **phase_fields,
         **ingest,
     }
     peak = _peak_flops(dev.device_kind)
@@ -347,7 +352,8 @@ def phase_ingest() -> dict:
         "ingest_bench": {
             k: r[k]
             for k in (
-                "rate", "t_dispatch_ms", "t_ingest_ms",
+                "rate", "t_dispatch_ms", "t_dispatch_p95",
+                "t_ingest_ms", "t_ingest_p95",
                 "ingest_rows_per_sec", "ingest_ship_calls",
                 "ingest_coalesce_mean", "ingest_stall_ms",
                 "ingest_ship_ms", "ingest_queue_rows",
@@ -392,7 +398,11 @@ def phase_scaling() -> dict:
                 "global_batch": r["global_batch"],
                 "rows_per_sec": round(r["rate"] * r["global_batch"], 1),
                 "t_dispatch_ms": r["t_dispatch_ms"],
+                # Tails: the 8-device ingest regression (BENCH_r05) was
+                # invisible in these means — p95 puts it in the curve.
+                "t_dispatch_p95": r.get("t_dispatch_p95", 0.0),
                 "t_ingest_ms": r["t_ingest_ms"],
+                "t_ingest_p95": r.get("t_ingest_p95", 0.0),
                 "ingest_rows_per_sec": r["ingest_rows_per_sec"],
                 "ingest_coalesce_mean": r["ingest_coalesce_mean"],
                 "ingest_stall_ms": r["ingest_stall_ms"],
@@ -730,13 +740,13 @@ def main() -> int:
         result["device_kind"] = accel["device_kind"]
         result["n_devices"] = accel["n_devices"]
         result["per_device_rate"] = round(accel["per_device_rate"], 1)
-        for key in ("t_dispatch_ms", "t_ingest_ms", "chunk",
-                    "fused_chunk_error", "fused_chunk_active",
-                    "ingest_rows_per_sec", "ingest_rows_staged",
-                    "ingest_ship_calls", "ingest_coalesce_mean",
-                    "ingest_stall_ms", "ingest_ship_ms",
-                    "ingest_queue_rows"):
-            if key in accel:
+        for key in accel:
+            # Phase breakdown (means + p50/p95/max tails), call counts,
+            # and the full ingest_* family ride to the top-level record.
+            if key.startswith(("t_dispatch", "t_ingest", "n_dispatch",
+                               "n_ingest", "ingest_")) or key in (
+                "chunk", "fused_chunk_error", "fused_chunk_active",
+            ):
                 result[key] = accel[key]
         if "mfu" in accel:
             result["mfu"] = round(accel["mfu"], 5)

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.actors.policy import (
     actor_head_dim,
     decode_version,
@@ -168,6 +169,10 @@ class ActorPool:
                 log_std_max=self.config.sac_log_std_max,
                 warmup_uniform=self.warmup_budget_per_worker(),
                 episode_queue=self._episodes,
+                # Flight recorder: workers are separate processes, so each
+                # keeps its OWN ring and exports trace_actor<k>.json on
+                # clean exit; Perfetto merges the files by pid.
+                trace_dir=self.config.trace_dir,
                 # Orphan guard (worker.py): the worker compares getppid()
                 # against the pool process's REAL pid, captured here at
                 # spawn time — a late in-worker getppid() capture races
@@ -218,11 +223,12 @@ class ActorPool:
 
         `learner_step` stamps which learner step these params come from so
         experience can be attributed a staleness (see staleness())."""
-        flat = flatten_params(actor_params)
-        view = np.frombuffer(self._shared, dtype=np.float32)
-        self._version.value += 1   # odd: write in progress
-        view[:] = flat
-        self._version.value += 1   # even: consistent
+        with trace.span("param_broadcast", learner_step=int(learner_step)):
+            flat = flatten_params(actor_params)
+            view = np.frombuffer(self._shared, dtype=np.float32)
+            self._version.value += 1   # odd: write in progress
+            view[:] = flat
+            self._version.value += 1   # even: consistent
         self._last_broadcast_step = int(learner_step)
         self._version_steps[self._version.value] = self._last_broadcast_step
         while len(self._version_steps) > 64:
@@ -362,6 +368,10 @@ class ActorPool:
                     p.join(timeout=2.0)
                 self._respawns += 1
                 respawned += 1
+                trace.instant(
+                    "actor_respawn", worker=i,
+                    why=("dead" if dead else "silent"),
+                )
                 self._spawn(i)
         return {"respawned": respawned, "total_respawns": self._respawns}
 

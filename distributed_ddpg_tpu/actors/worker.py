@@ -50,6 +50,7 @@ def run_worker(
     warmup_uniform: int = 0,  # uniform-random actions for the first N steps
     episode_queue=None,     # optional mp.Queue for (worker_id, return, length)
     parent_pid: int = 0,    # pool process pid, captured at spawn time
+    trace_dir: str = "",    # flight-recorder export dir ("" = off)
 ) -> None:
     # Workers are CPU-only by construction; make BLAS behave in many procs.
     os.environ.setdefault("OMP_NUM_THREADS", "1")
@@ -60,10 +61,18 @@ def run_worker(
     # the solo cost, and stamping mid-boot would arm the silent-timeout
     # respawn before the worker can possibly meet it.
 
+    from distributed_ddpg_tpu import trace
     from distributed_ddpg_tpu.actors.policy import NumpyPolicy, encode_version
     from distributed_ddpg_tpu.envs import make
     from distributed_ddpg_tpu.ops.noise import OUNoise
     from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
+
+    # Flight recorder (trace.py): a worker is its own interpreter, so it
+    # owns its own ring and exports a per-process file on exit — Perfetto
+    # merges by pid. Spans cover flushes (transport waits show up as long
+    # actor_flush spans = learner-side backpressure) and episode instants.
+    if trace_dir:
+        trace.configure(capacity=8192)
 
     env = make(env_id, seed=seed)
     act_dim = len(np.atleast_1d(action_low))
@@ -126,6 +135,10 @@ def run_worker(
         # seen_version tags which param snapshot produced this experience —
         # the pool converts it to learner-step staleness (SURVEY.md §5
         # 'params-staleness per actor').
+        with trace.span("actor_flush", rows=len(pending)):
+            _flush_impl()
+
+    def _flush_impl():
         nonlocal carry
         if ring is not None:
             if pending:
@@ -203,9 +216,11 @@ def run_worker(
     # with a pool that dies during worker boot) has no consumer left, so
     # it must exit — without flush(), whose ring backpressure would
     # otherwise block forever on the dead drainer.
+    orphaned = False
     while not stop_flag.value:
         if parent_pid and os.getppid() != parent_pid:
-            return
+            orphaned = True
+            break
         heartbeat[worker_id] = time.time()
         maybe_refresh()
         if throttle_s > 0.0:
@@ -243,6 +258,9 @@ def run_worker(
             # experience is stranded, then reset per-episode state.
             if truncated and not terminated:
                 pending.extend(_flush_truncated(nstep, next_obs))
+            trace.instant(
+                "episode", ret=round(ep_return, 3), length=ep_len
+            )
             if episode_queue is not None:
                 try:
                     episode_queue.put_nowait((worker_id, ep_return, ep_len))
@@ -256,7 +274,17 @@ def run_worker(
         if len(pending) >= send_every:
             flush()
 
-    flush()
+    # Orphaned workers skip the final flush (its backpressure would block
+    # forever on the dead drainer) but still try to land their trace.
+    if not orphaned:
+        flush()
+    if trace_dir:
+        try:
+            trace.export(
+                os.path.join(trace_dir, f"trace_actor{worker_id}.json")
+            )
+        except Exception:
+            pass  # diagnostics must never fail a clean worker exit
 
 
 def _flush_truncated(nstep, bootstrap_obs):

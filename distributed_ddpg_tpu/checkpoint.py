@@ -201,8 +201,11 @@ class AsyncSaver:
             ckpt = _snapshot(step, state, replay, env_steps, v_bounds=v_bounds)
 
             def _run():
+                from distributed_ddpg_tpu import trace
+
                 try:
-                    _write(directory, step, ckpt, config, keep=keep)
+                    with trace.span("ckpt_write", step=step):
+                        _write(directory, step, ckpt, config, keep=keep)
                 except Exception as e:  # surfaced via .errors / wait()
                     self.errors.append(e)
 

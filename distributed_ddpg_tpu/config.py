@@ -240,6 +240,18 @@ class DDPGConfig:
     log_path: str = ""               # JSONL metrics path ("" = stdout only)
     tb_dir: str = ""                 # TensorBoard summary dir ("" = off)
     profile_dir: str = ""            # jax.profiler trace dir ("" = off)
+    # Flight-recorder tracing (trace.py): when set, train_jax records
+    # thread-tagged spans from every hot component (learner phases, ingest
+    # shipper, prefetcher, eval/ckpt threads, actor workers) into a
+    # preallocated ring and writes Perfetto-loadable Chrome trace JSON
+    # here on clean exit, on SIGUSR2, and from the watchdog's stall path
+    # (which also drops stall_report.json). "" = off (the span calls are
+    # shared no-op context managers). Cheap enough to leave on for every
+    # production run — see docs/OBSERVABILITY.md.
+    trace_dir: str = ""
+    # Ring capacity in events; at steady state ~4 events per learner chunk
+    # + shipper/eval activity, 65536 holds tens of minutes of timeline.
+    trace_events: int = 65_536
     inject_fault: str = ""           # fault-injection hook (SURVEY.md §5)
 
     def replace(self, **kwargs) -> "DDPGConfig":
@@ -447,6 +459,8 @@ class DDPGConfig:
             )
         if self.param_refresh_interval_s < 0:
             raise ValueError("param_refresh_interval_s must be >= 0")
+        if self.trace_events < 16:
+            raise ValueError("trace_events must be >= 16")
         if self.transport not in ("auto", "shm", "queue"):
             raise ValueError(
                 f"transport must be 'auto', 'shm', or 'queue', got "

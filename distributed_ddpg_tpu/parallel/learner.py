@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.learner import (
     METRIC_KEYS,
@@ -590,7 +591,10 @@ class ShardedLearner:
     def put_chunk(self, np_batches: Dict[str, np.ndarray]):
         """Pack a [K, B, field] dict into the single wire array and start
         its (async) transfer to HBM with the chunk sharding."""
-        return jax.device_put(pack_batch_np(np_batches), self._chunk_sharding)
+        with trace.span("chunk_h2d"):
+            return jax.device_put(
+                pack_batch_np(np_batches), self._chunk_sharding
+            )
 
     # --- K steps per dispatch, sampling fused on device ---
 
@@ -697,8 +701,18 @@ class ShardedLearner:
     # --- host-side views ---
 
     def actor_params_to_host(self):
-        """Numpy actor params for broadcast to CPU rollout workers."""
-        return jax.tree.map(np.asarray, jax.device_get(self.state.actor_params))
+        """Numpy actor params for broadcast to CPU rollout workers. The
+        span matters: this d2h syncs the in-flight chunk, and on a
+        tunneled TPU it is the single most expensive host-visible call —
+        the timeline shows it as the learner-thread gap before every
+        param refresh / eval snapshot."""
+        with trace.span("params_d2h"):
+            return jax.tree.map(
+                np.asarray, jax.device_get(self.state.actor_params)
+            )
 
     def metrics_to_host(self, out: StepOutput) -> Dict[str, float]:
-        return {k: float(v) for k, v in jax.device_get(out.metrics).items()}
+        with trace.span("metrics_d2h"):
+            return {
+                k: float(v) for k, v in jax.device_get(out.metrics).items()
+            }

@@ -20,6 +20,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from distributed_ddpg_tpu import trace
+
 
 class PrefetchTimeout(RuntimeError):
     """next() deadline expired with the worker thread still alive — replay
@@ -54,13 +56,18 @@ class ChunkPrefetcher:
         return self
 
     def _sample_chunk(self) -> Dict[str, np.ndarray]:
-        samples = []
-        with self._lock:
-            for _ in range(self._chunk):
-                samples.append(self._replay.sample(self._batch_size))
-        return {
-            k: np.stack([s[k] for s in samples]) for k in samples[0]
-        }
+        # Flight-recorder span: host-replay sampling time on the prefetch
+        # thread — when the learner's sample_wait phase grows, the
+        # timeline shows whether THIS (lock contention, sample cost) or
+        # the h2d below is the bottleneck.
+        with trace.span("prefetch_sample"):
+            samples = []
+            with self._lock:
+                for _ in range(self._chunk):
+                    samples.append(self._replay.sample(self._batch_size))
+            return {
+                k: np.stack([s[k] for s in samples]) for k in samples[0]
+            }
 
     def _run(self) -> None:
         try:
@@ -73,7 +80,8 @@ class ChunkPrefetcher:
                 # strand the join behind a transfer nobody will consume.
                 if self._stop.is_set():
                     return
-                device_chunk = self._put(chunk)
+                with trace.span("prefetch_h2d"):
+                    device_chunk = self._put(chunk)
                 # Block here (not in get()) when the queue is full — this is
                 # the backpressure that makes `depth` the buffer bound.
                 while not self._stop.is_set():

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.metrics import IngestStats
 from distributed_ddpg_tpu.replay.staging import HostStagingRing
 from distributed_ddpg_tpu.types import packed_width
@@ -311,7 +312,8 @@ class DeviceReplay:
                     rows = self._ring.pop(k * self.block_size)
                     self._staging.notify_all()
                 t0 = time.perf_counter()
-                self._ship(rows)
+                with trace.span("ingest_ship", rows=len(rows), blocks=k):
+                    self._ship(rows)
                 self._stats.record_ship(
                     len(rows), k, time.perf_counter() - t0
                 )
@@ -342,6 +344,13 @@ class DeviceReplay:
                         # inline ship below.
                         break
                 stall = time.perf_counter() - t0
+                if stall > 0.001:
+                    # Producer blocked on a full staging ring: the
+                    # backpressure interval as a span, so the timeline
+                    # shows WHO was stalled while the shipper dispatched.
+                    trace.complete(
+                        "ingest_backpressure", t0, stall, rows=len(rows)
+                    )
             self._ring.push(rows)
             self._stats.record_push(len(rows), stall)
             self._staging.notify_all()
@@ -382,7 +391,8 @@ class DeviceReplay:
                 reps = -(-self.block_size // n)
                 chunk = np.tile(rows, (reps, 1))[: self.block_size]
                 t0 = time.perf_counter()
-                self._ship(chunk)
+                with trace.span("ingest_flush", rows=n):
+                    self._ship(chunk)
                 self._stats.record_ship(n, 1, time.perf_counter() - t0)
 
     def sync_ship(self, force: bool = False) -> int:
@@ -410,34 +420,47 @@ class DeviceReplay:
 
         from jax.experimental import multihost_utils
 
-        counts = np.asarray(
-            multihost_utils.process_allgather(np.int32(self.pending_rows))
-        )
-        m = int(counts.min())
-        moved = 0
-        cap_blocks = self.capacity // (self._procs * self.block_size)
-        remaining = m // self.block_size
-        with self.dispatch_lock:
-            while remaining:
-                k = self._coalesce_k(remaining, cap_blocks)
-                with self._staging:
-                    rows = self._ring.pop(k * self.block_size)
-                t0 = time.perf_counter()
-                self._ship_global(rows, k=k)
-                self._stats.record_ship(
-                    k * self.block_size, k, time.perf_counter() - t0
-                )
-                moved += k * self.block_size
-                remaining -= k
-            if force and m % self.block_size:
-                take = min(self.pending_rows, self.block_size)
-                with self._staging:
-                    rows = self._ring.pop(take)
-                reps = -(-self.block_size // take)
-                t0 = time.perf_counter()
-                self._ship_global(np.tile(rows, (reps, 1))[: self.block_size])
-                self._stats.record_ship(take, 1, time.perf_counter() - t0)
-                moved += take
+        # One span over the whole lockstep beat (count all-gather +
+        # ships): on the timeline this is the learner thread blocked on
+        # the DCN collective — the cost the ROADMAP lockstep-token item
+        # wants to overlap, now measurable per beat.
+        with trace.span("sync_ship"):
+            counts = np.asarray(
+                multihost_utils.process_allgather(np.int32(self.pending_rows))
+            )
+            m = int(counts.min())
+            moved = 0
+            cap_blocks = self.capacity // (self._procs * self.block_size)
+            remaining = m // self.block_size
+            with self.dispatch_lock:
+                while remaining:
+                    k = self._coalesce_k(remaining, cap_blocks)
+                    with self._staging:
+                        rows = self._ring.pop(k * self.block_size)
+                    t0 = time.perf_counter()
+                    with trace.span(
+                        "ingest_ship_global", rows=k * self.block_size,
+                        blocks=k,
+                    ):
+                        self._ship_global(rows, k=k)
+                    self._stats.record_ship(
+                        k * self.block_size, k, time.perf_counter() - t0
+                    )
+                    moved += k * self.block_size
+                    remaining -= k
+                if force and m % self.block_size:
+                    take = min(self.pending_rows, self.block_size)
+                    with self._staging:
+                        rows = self._ring.pop(take)
+                    reps = -(-self.block_size // take)
+                    t0 = time.perf_counter()
+                    self._ship_global(
+                        np.tile(rows, (reps, 1))[: self.block_size]
+                    )
+                    self._stats.record_ship(
+                        take, 1, time.perf_counter() - t0
+                    )
+                    moved += take
         return moved
 
     def _get_global_insert(self, k: int):

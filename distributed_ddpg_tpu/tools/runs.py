@@ -1,0 +1,463 @@
+"""Run-analysis CLI for the repo's JSONL/JSON artifacts.
+
+`runs/` holds ~100 train/eval/bench files and until this module the only
+tooling was hand-diffing them (how the 8-device ingest regression in
+BENCH_r05 was found). Three subcommands over the schemas the repo already
+produces (metrics.MetricsLogger records; bench.py result JSON — both
+documented in docs/OBSERVABILITY.md):
+
+  summarize <run.jsonl> [...]    per-run digest: record counts, steady-
+                                 state rates, per-phase breakdown table
+                                 (mean + p50/p95/max where recorded),
+                                 ingest pipeline table, eval curve.
+  compare  <a.jsonl> <b.jsonl>   side-by-side key metrics with % deltas —
+                                 the A/B view for "did this PR move
+                                 dispatch p95".
+  gate <base.json> <cand.json>   CI regression gate over two bench.py
+                                 JSONs: exit 2 when any gated key of the
+                                 candidate falls more than --threshold
+                                 below the baseline (or above, for
+                                 lower-is-better keys prefixed '-').
+
+Pure stdlib, no numpy/jax: this must be runnable anywhere, instantly —
+    python -m distributed_ddpg_tpu.tools.runs summarize runs/foo.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from statistics import mean
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a metrics JSONL file; non-JSON lines (stray prints interleave
+    with echo=True streams) are skipped, not fatal."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    """A bench.py result: one JSON object. Driver wrappers (BENCH_r*.json)
+    embed the object in a 'tail' string; unwrap when present so both
+    shapes gate/compare identically."""
+    with open(path) as f:
+        obj = json.load(f)
+    if "value" not in obj and isinstance(obj.get("tail"), str):
+        tail = obj["tail"]
+        start = tail.find('{"metric"')
+        if start >= 0:
+            try:
+                obj = json.loads(tail[start:])
+            except json.JSONDecodeError:
+                pass
+    return obj
+
+
+def by_kind(records: Sequence[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        out.setdefault(str(r.get("kind", "?")), []).append(r)
+    return out
+
+
+def phase_names(records: Sequence[Dict[str, Any]]) -> List[str]:
+    names = set()
+    for r in records:
+        for k in r:
+            if k.startswith("t_") and k.endswith("_ms"):
+                names.add(k[2:-3])
+    return sorted(names)
+
+
+# ---------------------------------------------------------------------------
+# tables
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:,.3f}" if abs(v) < 1000 else f"{v:,.1f}"
+    return str(v)
+
+
+def render_table(headers: Sequence[str], rows: Sequence[Sequence[Any]]) -> str:
+    cells = [[_fmt(c) for c in row] for row in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def line(row):
+        return "  ".join(
+            c.rjust(w) if i else c.ljust(w)
+            for i, (c, w) in enumerate(zip(row, widths))
+        )
+    out = [line(list(headers)), line(["-" * w for w in widths])]
+    out += [line(r) for r in cells]
+    return "\n".join(out)
+
+
+def _col(records, key) -> List[float]:
+    return [
+        r[key] for r in records
+        if isinstance(r.get(key), (int, float))
+        and not isinstance(r.get(key), bool)
+    ]
+
+
+def _tail_mean(vals: Sequence[float], frac: float = 0.25) -> Optional[float]:
+    """Mean of the last `frac` of the series — the steady-state estimate
+    (early records carry warmup/compile transients)."""
+    if not vals:
+        return None
+    n = max(1, int(len(vals) * frac))
+    return mean(vals[-n:])
+
+
+# ---------------------------------------------------------------------------
+# summarize
+# ---------------------------------------------------------------------------
+
+# The headline scalar columns a run summary/compare surfaces, in order.
+KEY_METRICS = (
+    "learner_steps_per_sec",
+    "actor_steps_per_sec",
+    "env_steps_per_sec",
+    "buffer_fill",
+    "staleness_mean",
+    "critic_loss",
+    "mean_q",
+)
+
+
+def summarize_run(path: str) -> Dict[str, Any]:
+    """Machine-readable digest of one JSONL run (the CLI renders it; tests
+    and future dashboards consume it directly)."""
+    records = load_jsonl(path)
+    kinds = by_kind(records)
+    train = kinds.get("train", [])
+    evals = kinds.get("eval", [])
+    final = kinds.get("final", [])
+    digest: Dict[str, Any] = {
+        "path": path,
+        "records": {k: len(v) for k, v in kinds.items()},
+        "steps": (
+            {"first": train[0].get("step"), "last": train[-1].get("step")}
+            if train
+            else {}
+        ),
+        "wall_time_s": records[-1].get("wall_time") if records else None,
+    }
+    metrics = {}
+    for key in KEY_METRICS:
+        vals = _col(train, key)
+        if vals:
+            metrics[key] = {
+                "steady": _tail_mean(vals),
+                "max": max(vals),
+                "last": vals[-1],
+            }
+    digest["metrics"] = metrics
+
+    phases = {}
+    for name in phase_names(train + final):
+        src = train if _col(train, f"t_{name}_ms") else final
+        entry = {
+            "mean_ms": _tail_mean(_col(src, f"t_{name}_ms")),
+            "calls": sum(int(v) for v in _col(src, f"n_{name}")),
+        }
+        for q in ("p50", "p95", "max"):
+            vals = _col(src, f"t_{name}_{q}")
+            if vals:
+                # max over intervals: the worst tail any interval saw.
+                entry[f"{q}_ms"] = max(vals)
+        phases[name] = entry
+    digest["phases"] = phases
+
+    ingest = {}
+    ingest_keys = sorted(
+        {k for r in train for k in r if k.startswith("ingest_")}
+    )
+    for key in ingest_keys:
+        vals = _col(train, key)
+        if vals:
+            ingest[key] = {"steady": _tail_mean(vals), "max": max(vals)}
+    digest["ingest"] = ingest
+
+    ev = _col(evals, "eval_return")
+    if ev:
+        digest["eval"] = {
+            "n": len(ev), "first": ev[0], "best": max(ev), "last": ev[-1],
+        }
+    if final:
+        digest["final"] = {
+            k: v for k, v in final[-1].items()
+            if k in ("learner_steps", "learner_steps_per_sec",
+                     "final_return", "param_checksum")
+        }
+    return digest
+
+
+def render_summary(digest: Dict[str, Any]) -> str:
+    out = [f"== {digest['path']}"]
+    rec = ", ".join(f"{k}:{v}" for k, v in sorted(digest["records"].items()))
+    steps = digest.get("steps") or {}
+    out.append(
+        f"records [{rec}]  steps {steps.get('first', '-')}"
+        f"..{steps.get('last', '-')}  wall {_fmt(digest.get('wall_time_s'))}s"
+    )
+    if digest.get("metrics"):
+        out.append("\n-- key metrics (steady = mean of last 25% of records)")
+        out.append(render_table(
+            ["metric", "steady", "max", "last"],
+            [
+                [k, m["steady"], m["max"], m["last"]]
+                for k, m in digest["metrics"].items()
+            ],
+        ))
+    if digest.get("phases"):
+        out.append("\n-- phase breakdown (ms per call)")
+        out.append(render_table(
+            ["phase", "mean", "p50", "p95", "max", "calls"],
+            [
+                [name, p.get("mean_ms"), p.get("p50_ms"), p.get("p95_ms"),
+                 p.get("max_ms"), p.get("calls")]
+                for name, p in digest["phases"].items()
+            ],
+        ))
+    if digest.get("ingest"):
+        out.append("\n-- ingest pipeline")
+        out.append(render_table(
+            ["field", "steady", "max"],
+            [
+                [k, v["steady"], v["max"]]
+                for k, v in digest["ingest"].items()
+            ],
+        ))
+    if digest.get("eval"):
+        e = digest["eval"]
+        out.append(
+            f"\n-- eval: n={e['n']} first={_fmt(e['first'])} "
+            f"best={_fmt(e['best'])} last={_fmt(e['last'])}"
+        )
+    if digest.get("final"):
+        out.append(
+            "-- final: "
+            + "  ".join(f"{k}={_fmt(v)}" for k, v in digest["final"].items())
+        )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# compare
+# ---------------------------------------------------------------------------
+
+
+def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
+    a, b = summarize_run(path_a), summarize_run(path_b)
+    rows: List[List[Any]] = []
+
+    def add(label, va, vb, lower_better=False):
+        if va is None and vb is None:
+            return
+        delta = None
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)) and va:
+            delta = 100.0 * (vb - va) / abs(va)
+        mark = ""
+        if delta is not None and abs(delta) >= 5.0:
+            worse = delta < 0 if not lower_better else delta > 0
+            mark = "!" if worse else "+"
+        rows.append([label, va, vb,
+                     f"{delta:+.1f}% {mark}" if delta is not None else "-"])
+
+    for key, ma in a.get("metrics", {}).items():
+        mb = b.get("metrics", {}).get(key, {})
+        add(key, ma.get("steady"), mb.get("steady"))
+    names = sorted(set(a.get("phases", {})) | set(b.get("phases", {})))
+    for name in names:
+        pa = a["phases"].get(name, {})
+        pb = b["phases"].get(name, {})
+        add(f"t_{name}_ms", pa.get("mean_ms"), pb.get("mean_ms"),
+            lower_better=True)
+        if pa.get("p95_ms") is not None or pb.get("p95_ms") is not None:
+            add(f"t_{name}_p95", pa.get("p95_ms"), pb.get("p95_ms"),
+                lower_better=True)
+    for key in sorted(set(a.get("ingest", {})) | set(b.get("ingest", {}))):
+        ia = a["ingest"].get(key, {})
+        ib = b["ingest"].get(key, {})
+        add(key, ia.get("steady"), ib.get("steady"),
+            lower_better=("stall" in key or "queue" in key or "_ms" in key))
+    ea, eb = a.get("eval", {}), b.get("eval", {})
+    add("eval_best", ea.get("best"), eb.get("best"))
+    fa, fb = a.get("final", {}), b.get("final", {})
+    add("final_return", fa.get("final_return"), fb.get("final_return"))
+    add("final_learner_steps_per_sec", fa.get("learner_steps_per_sec"),
+        fb.get("learner_steps_per_sec"))
+    table = render_table(["metric (steady)", "A", "B", "delta"], rows)
+    header = f"A = {path_a}\nB = {path_b}\n('!' = >=5% worse, '+' = >=5% better)"
+    return header + "\n" + table, rows
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+DEFAULT_GATE_KEYS = ("value",)
+
+
+def _lookup(obj: Dict[str, Any], dotted: str):
+    """Resolve 'scaling_cpu_virtual.scaled_batch.8.rows_per_sec' style
+    paths into nested bench JSON."""
+    cur: Any = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def gate_bench(
+    baseline: Dict[str, Any],
+    candidate: Dict[str, Any],
+    threshold: float,
+    keys: Sequence[str] = DEFAULT_GATE_KEYS,
+) -> Tuple[bool, List[str]]:
+    """True = pass. A key prefixed '-' is lower-is-better (latencies);
+    otherwise higher-is-better (rates). A key missing from the CANDIDATE
+    while present in the baseline FAILS (a silently dropped metric must
+    not read as healthy); missing from both is skipped with a note."""
+    ok = True
+    lines = []
+    for raw in keys:
+        lower_better = raw.startswith("-")
+        key = raw[1:] if lower_better else raw
+        base = _lookup(baseline, key)
+        cand = _lookup(candidate, key)
+        if not isinstance(base, (int, float)) or isinstance(base, bool):
+            lines.append(f"SKIP {key}: not in baseline ({base!r})")
+            continue
+        if not isinstance(cand, (int, float)) or isinstance(cand, bool):
+            ok = False
+            lines.append(f"FAIL {key}: missing from candidate ({cand!r})")
+            continue
+        if base == 0:
+            lines.append(f"SKIP {key}: baseline is 0")
+            continue
+        ratio = cand / base
+        if lower_better:
+            bad = ratio > 1.0 + threshold
+            rel = ratio - 1.0
+        else:
+            bad = ratio < 1.0 - threshold
+            rel = ratio - 1.0
+        verdict = "FAIL" if bad else "ok"
+        lines.append(
+            f"{verdict:4s} {key}: baseline={base:g} candidate={cand:g} "
+            f"({rel:+.1%}, threshold ±{threshold:.0%}, "
+            f"{'lower' if lower_better else 'higher'}-is-better)"
+        )
+        ok = ok and not bad
+    return ok, lines
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_ddpg_tpu.tools.runs",
+        description=__doc__.split("\n\n")[0],
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_sum = sub.add_parser("summarize", help="digest one or more JSONL runs")
+    p_sum.add_argument("paths", nargs="+")
+    p_sum.add_argument("--json", action="store_true",
+                       help="emit the digest as JSON instead of tables")
+
+    p_cmp = sub.add_parser("compare", help="A/B two JSONL runs")
+    p_cmp.add_argument("path_a")
+    p_cmp.add_argument("path_b")
+
+    p_gate = sub.add_parser(
+        "gate", help="CI regression gate over two bench JSONs "
+        "(exit 2 on regression)",
+    )
+    p_gate.add_argument("baseline")
+    p_gate.add_argument("candidate")
+    p_gate.add_argument("--threshold", type=float, default=0.1,
+                        help="allowed relative regression (default 0.10)")
+    p_gate.add_argument(
+        "--keys", default=",".join(DEFAULT_GATE_KEYS),
+        help="comma-separated bench keys; prefix '-' for lower-is-better "
+        "(e.g. value,-t_dispatch_ms,ingest_rows_per_sec); dotted paths "
+        "descend into nested objects",
+    )
+    args = parser.parse_args(argv)
+
+    if args.cmd == "summarize":
+        for i, path in enumerate(args.paths):
+            try:
+                digest = summarize_run(path)
+            except OSError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(digest))
+            else:
+                if i:
+                    print()
+                print(render_summary(digest))
+        return 0
+
+    if args.cmd == "compare":
+        try:
+            text, _ = compare_runs(args.path_a, args.path_b)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(text)
+        return 0
+
+    if args.cmd == "gate":
+        try:
+            base = load_bench(args.baseline)
+            cand = load_bench(args.candidate)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        keys = [k for k in args.keys.split(",") if k]
+        ok, lines = gate_bench(base, cand, args.threshold, keys)
+        for line in lines:
+            print(line)
+        print("GATE PASS" if ok else "GATE FAIL")
+        return 0 if ok else 2
+
+    return 1  # unreachable (subparsers required)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

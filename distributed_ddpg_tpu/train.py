@@ -17,6 +17,7 @@ Usage:
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
 import threading
 import time
@@ -25,6 +26,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from distributed_ddpg_tpu import checkpoint as ckpt_lib
+from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs import make, spec_of
 from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, Timer
@@ -327,6 +329,33 @@ def _jax_env_spec(trainer):
 
 
 def train_jax(config: DDPGConfig) -> Dict[str, float]:
+    # Flight recorder (trace.py): armed for the whole device lifetime so
+    # the watchdog's stall path below can ship the last-N-seconds
+    # timeline with its stack dump. Exported on clean exit and on demand
+    # (SIGUSR2 — the stack-dump sibling of _enable_faulthandler's
+    # SIGUSR1, for peeking at a LIVE run's timeline without killing it).
+    trace_path = ""
+    if config.trace_dir:
+        trace.configure(capacity=config.trace_events)
+        trace_path = os.path.join(config.trace_dir, "trace.json")
+        import signal
+
+        def _export_on_signal(*_):
+            # A read-only diagnostic poke must never crash the healthy
+            # run it inspects (a raise here propagates into whatever the
+            # learner thread was executing).
+            try:
+                trace.export(trace_path)
+            except Exception as e:
+                print(f"[trace] SIGUSR2 export failed: {e!r}",
+                      file=sys.stderr, flush=True)
+
+        if hasattr(signal, "SIGUSR2"):
+            try:
+                signal.signal(signal.SIGUSR2, _export_on_signal)
+            except ValueError:
+                pass  # not on the main thread (embedded callers): no signal
+
     # Stall watchdog (watchdog.py): covers the WHOLE device lifetime of
     # the impl below — backend/PJRT init (resolve_learner_chunk's
     # platform probe and ShardedLearner), the first params d2h at
@@ -345,7 +374,14 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     if config.watchdog_s > 0:
         from distributed_ddpg_tpu.watchdog import Watchdog
 
-        watchdog = Watchdog(config.watchdog_s, progress=lambda: _beat_n[0]).start()
+        watchdog = Watchdog(
+            config.watchdog_s,
+            progress=lambda: _beat_n[0],
+            # Stall artifacts land next to the trace when tracing is on,
+            # else next to checkpoints, else the cwd — a stall must always
+            # leave its structured report somewhere findable.
+            stall_dir=(config.trace_dir or config.checkpoint_dir or "."),
+        ).start()
 
     def _grant(extra_s: float) -> None:
         if watchdog is not None:
@@ -356,6 +392,22 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     finally:
         if watchdog is not None:
             watchdog.stop()
+        if trace_path:
+            try:
+                n = trace.export(trace_path)
+                print(
+                    f"[trace] {n} events -> {trace_path} "
+                    "(load in ui.perfetto.dev)",
+                    file=sys.stderr,
+                )
+            except Exception as e:
+                # Diagnostics must never turn a finished run into a
+                # failure (or mask an in-flight exception): a full disk
+                # at export time loses the trace, not the run.
+                print(f"[trace] export failed: {e!r}",
+                      file=sys.stderr, flush=True)
+            finally:
+                trace.disable()
 
 
 def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> Dict[str, float]:
@@ -546,18 +598,22 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             flat = flatten_params(learner.actor_params_to_host())
 
         def _run():
-            policy = NumpyPolicy(
-                param_layout(
-                    spec.obs_dim,
-                    actor_head_dim(spec.act_dim, config.sac),
-                    tuple(config.actor_hidden),
-                ),
-                spec.action_scale,
-                spec.action_offset,
-                gaussian=config.sac,
-            )
-            policy.load_flat(flat)
-            log.log("eval", at_step, eval_return=_eval_numpy(policy, config, spec))
+            with trace.span("eval_rollout", step=at_step):
+                policy = NumpyPolicy(
+                    param_layout(
+                        spec.obs_dim,
+                        actor_head_dim(spec.act_dim, config.sac),
+                        tuple(config.actor_hidden),
+                    ),
+                    spec.action_scale,
+                    spec.action_offset,
+                    gaussian=config.sac,
+                )
+                policy.load_flat(flat)
+                log.log(
+                    "eval", at_step,
+                    eval_return=_eval_numpy(policy, config, spec),
+                )
 
         if config.strict_sync:
             # Lockstep mode: eval runs synchronously so the metrics stream
@@ -849,9 +905,22 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             if (
                 use_device_replay
                 and not is_multi
-                and moved
                 and buffer_fill() + device_replay.pending_rows >= min_fill
             ):
+                # NOT gated on `moved`: this check races the async
+                # shipper — at the instant it ships a block, the rows are
+                # already popped from the ring (pending drops) but the
+                # insert hasn't updated size yet (fill unchanged), so the
+                # sum transiently under-counts. With a drain cap
+                # (max_ingest_ratio) the crossing iteration can be the
+                # LAST one with moved > 0, and a moved-gated check that
+                # lost the race would never re-fire: sub-block remainder
+                # rows sit staged forever while drains return 0 — a
+                # warmup livelock (observed: fill 1024 + pending 476
+                # against min_fill 1500, wedged). Re-evaluating every
+                # iteration self-heals; flush() is idempotent-cheap when
+                # there is nothing staged, and the loop exits as soon as
+                # the fill crosses, so at most one padded ship happens.
                 device_replay.flush()
             if moved:
                 last_moved_t = time.monotonic()
@@ -860,6 +929,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 time.sleep(0.05)
             warm_it += 1
 
+        trace.instant("warmup_done", buffer_fill=buffer_fill())
         if config.distributional and learner.config.v_support_auto:
             # C51 auto-support (ops/support_auto.py): size [v_min, v_max]
             # from the warmup replay's (n-step) reward statistics. Gated on
@@ -916,6 +986,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 else:
                     budget_now = env_steps()
                 if budget_now >= config.total_env_steps and learn_steps > 0:
+                    trace.instant(
+                        "budget_met", env_steps=budget_now,
+                        learn_steps=learn_steps,
+                    )
                     # `learn_steps > 0` guards the degenerate exit where fast
                     # actors deliver the entire env-step budget during warmup
                     # (max_ingest_ratio=0 = free ingest): a run that has met

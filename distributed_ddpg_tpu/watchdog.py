@@ -11,13 +11,24 @@ the remote tunnel dropped. A hang is the worst outcome for a driver-managed
 run: a crash gets retried/diagnosed, a hang eats the whole wall-clock
 budget.
 
-`Watchdog` converts that hang into a loud, debuggable crash: a daemon
-thread samples a progress value; if it stops advancing for `timeout_s`,
-the watchdog dumps EVERY thread's stack to stderr (faulthandler — shows
-exactly which device call wedged) and hard-exits via `os._exit` (the
-default `on_stall`). `os._exit` is deliberate: normal teardown would block
-on the same wedged device (pool.stop syncs, AsyncSaver waits), and atexit
-handlers of a wedged PJRT client can hang too.
+`Watchdog` converts that hang into a loud, debuggable crash. When progress
+stops advancing for `timeout_s` it:
+
+  1. writes a STRUCTURED stall report (`stall_report.json`: every thread's
+     stack as JSON, last progress value, seconds stalled) plus — when the
+     flight recorder (trace.py) is enabled — `stall_trace.json`, the
+     last-N-seconds cross-thread timeline, into `stall_dir`. Both writes
+     are best-effort: a full disk must not mask the stall itself;
+  2. dumps every thread's stack to stderr (faulthandler — shows exactly
+     which device call wedged) and hard-exits via `os._exit` (the default
+     `on_stall`). `os._exit` is deliberate: normal teardown would block on
+     the same wedged device (pool.stop syncs, AsyncSaver waits), and
+     atexit handlers of a wedged PJRT client can hang too.
+
+Step 1 is what turns "exit 70 + a wall of stacks" into a diagnosable
+artifact set: the trace answers what the shipper/prefetcher/eval threads
+were doing in the seconds BEFORE the learner thread wedged, which the
+stack dump (a single instant) cannot.
 
 Enabled by `config.watchdog_s > 0` (train.py wires it around train_jax's
 whole device lifetime, including learner construction and the first
@@ -41,6 +52,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from distributed_ddpg_tpu import trace
 
 _EXIT_CODE = 70  # EX_SOFTWARE: internal failure, distinguishable from OOM/kill
 
@@ -63,6 +75,12 @@ class Watchdog:
     (a device call inside the watchdog would wedge the watchdog with the
     thing it watches) — an int counter bumped by the supervised loop is the
     intended shape.
+
+    `stall_dir`: where the structured stall artifacts land before
+    `on_stall` runs (stall_report.json + stall_trace.json — see module
+    docstring). None disables artifact writing (unit tests of the bare
+    firing logic). `trace_window_s` bounds the exported timeline to the
+    run-up to the stall.
     """
 
     def __init__(
@@ -70,16 +88,23 @@ class Watchdog:
         timeout_s: float,
         progress: Callable[[], object],
         on_stall: Optional[Callable[[], None]] = None,
+        stall_dir: Optional[str] = None,
+        trace_window_s: float = 30.0,
     ):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
         self._timeout_s = timeout_s
         self._progress = progress
         self._on_stall = on_stall or (lambda: _default_on_stall(timeout_s))
+        self._stall_dir = stall_dir
+        self._trace_window_s = trace_window_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._grant_deadline = 0.0
         self._grant_lock = threading.Lock()
+        # Paths written by the stall path; exposed so a custom on_stall
+        # (tests, alternative supervisors) can pick the artifacts up.
+        self.stall_artifacts: dict = {}
 
     def grant(self, extra_s: float) -> None:
         """Suppress firing until `extra_s` seconds from NOW (wall-clock
@@ -108,6 +133,31 @@ class Watchdog:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def _write_stall_artifacts(self, last_value, stalled_s: float) -> None:
+        """Best-effort structured stall dump BEFORE on_stall (which, by
+        default, os._exits). trace.stall_report never raises."""
+        if self._stall_dir is None:
+            return
+        self.stall_artifacts = trace.stall_report(
+            self._stall_dir,
+            reason=(
+                f"watchdog: no trainer progress for {self._timeout_s:.0f}s"
+            ),
+            timeout_s=self._timeout_s,
+            window_s=self._trace_window_s,
+            extra={
+                "last_progress_value": repr(last_value),
+                "stalled_s": round(stalled_s, 3),
+            },
+        )
+        if self.stall_artifacts:
+            sys.stderr.write(
+                "watchdog: stall artifacts written: "
+                + ", ".join(sorted(self.stall_artifacts.values()))
+                + "\n"
+            )
+            sys.stderr.flush()
+
     def _run(self) -> None:
         last = self._progress()
         last_change = time.monotonic()
@@ -124,5 +174,6 @@ class Watchdog:
                 with self._grant_lock:
                     granted = now < self._grant_deadline
                 if not granted:
+                    self._write_stall_artifacts(last, now - last_change)
                     self._on_stall()
                     return

@@ -284,10 +284,12 @@ def test_bench_ingest_smoke(monkeypatch):
     out = bench.phase_ingest()
     fields = out["ingest_bench"]
     for key in (
-        "rate", "t_dispatch_ms", "t_ingest_ms",
+        "rate", "t_dispatch_ms", "t_dispatch_p95",
+        "t_ingest_ms", "t_ingest_p95",
         "ingest_rows_per_sec", "ingest_ship_calls", "ingest_coalesce_mean",
         "ingest_stall_ms", "ingest_ship_ms", "ingest_queue_rows",
     ):
         assert key in fields, key
     assert fields["rate"] > 0
     assert fields["ingest_ship_calls"] >= 1
+    assert fields["t_dispatch_p95"] >= 0

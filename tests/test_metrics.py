@@ -1,12 +1,15 @@
-"""MetricsLogger tests (SURVEY.md §5 'Metrics / logging'): JSONL records and
-the TensorBoard parity sink."""
+"""MetricsLogger tests (SURVEY.md §5 'Metrics / logging'): JSONL records,
+field-type preservation, PhaseTimers tail latencies, and the TensorBoard
+parity sink."""
 
 import json
 import os
+import time
 
+import numpy as np
 import pytest
 
-from distributed_ddpg_tpu.metrics import MetricsLogger, Timer
+from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, Timer, _jsonable
 
 
 def test_jsonl_records(tmp_path):
@@ -39,9 +42,84 @@ def test_tensorboard_sink(tmp_path):
     assert os.path.getsize(events[0]) > 0
 
 
+def test_jsonable_preserves_bool_and_int_types(tmp_path):
+    """The old blanket float() coerced bools to 1.0/0.0 and ints to
+    floats in every JSONL record — downstream parsers then can't tell
+    `fused_chunk_active: true` from a measured scalar. Native AND numpy
+    scalar types must round-trip; float rounding stays."""
+    assert _jsonable(True) is True
+    assert _jsonable(False) is False
+    assert _jsonable(np.bool_(True)) is True
+    assert _jsonable(7) == 7 and isinstance(_jsonable(7), int)
+    assert _jsonable(np.int64(7)) == 7 and isinstance(_jsonable(np.int64(7)), int)
+    assert _jsonable(1.23456789) == 1.234568
+    assert _jsonable(np.float32(0.5)) == 0.5
+    assert _jsonable("s") == "s" and _jsonable(None) is None
+
+    path = tmp_path / "m.jsonl"
+    log = MetricsLogger(str(path), echo=False)
+    log.log("train", 1, active=True, count=3, loss=0.25)
+    log.close()
+    rec = json.loads(path.read_text())
+    assert rec["active"] is True
+    assert rec["count"] == 3 and not isinstance(rec["count"], float)
+    assert rec["loss"] == 0.25
+
+
 def test_timer_rates():
     t = Timer()
     t.tick(10)
     assert t.rate() > 0
     t.reset()
     assert t.rate() == 0.0
+
+
+def test_timer_survives_wall_clock_jumps(monkeypatch):
+    """Timer measures on the monotonic clock: a wall-clock step (NTP,
+    manual date set) mid-window must not distort the rate."""
+    t = Timer()
+    t.tick(100)
+    # A wall-clock jump would change time.time() arbitrarily; the rate
+    # must derive from time.monotonic() only.
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() + 3600.0)
+    rate = t.rate()
+    assert rate > 10  # 100 ticks over ms-scale elapsed, not over an hour
+
+
+def test_phase_timers_percentiles_and_reset():
+    p = PhaseTimers()
+    for i in range(40):
+        with p.phase("dispatch"):
+            # One 25ms outlier against fast calls: sleep granularity on a
+            # busy box is ~1ms, so the outlier is placed 10x above any
+            # plausible jitter on the fast path.
+            time.sleep(0.025 if i == 39 else 0.0002)
+    snap = p.snapshot()
+    assert snap["n_dispatch"] == 40
+    for key in ("t_dispatch_ms", "t_dispatch_p50", "t_dispatch_p95",
+                "t_dispatch_max"):
+        assert key in snap, key
+    # Ordering invariants of a (mean, p50, p95, max) family over a
+    # distribution with one large outlier.
+    assert snap["t_dispatch_p50"] <= snap["t_dispatch_p95"] <= snap["t_dispatch_max"]
+    assert snap["t_dispatch_max"] >= 20.0  # the 25ms outlier, in ms
+    assert snap["t_dispatch_p50"] < 15.0   # the typical fast call
+    # Interval reset: the next snapshot starts fresh.
+    assert p.snapshot() == {}
+
+
+def test_phase_timers_emit_trace_spans():
+    """Every phase bracket doubles as a flight-recorder span (the same
+    bracket feeds the scalar record and the Perfetto timeline)."""
+    from distributed_ddpg_tpu import trace
+
+    trace.configure(capacity=64)
+    try:
+        p = PhaseTimers()
+        with p.phase("ckpt"):
+            pass
+        spans = [e for e in trace.get().events() if e["ph"] == "X"]
+        assert any(e["name"] == "ckpt" for e in spans)
+    finally:
+        trace.disable()

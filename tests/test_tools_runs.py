@@ -1,0 +1,196 @@
+"""tools.runs CLI tests (the tier-1 smoke the ISSUE's CI satellite asks
+for): summarize + compare over fixture JSONL in the exact schema
+metrics.MetricsLogger emits, and the bench-JSON regression gate — which
+must exit nonzero on a synthetic 20% grad_steps_per_sec regression (the
+PR's acceptance criterion)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from distributed_ddpg_tpu.tools import runs
+
+
+def _write_jsonl(path, records):
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+
+
+def _fixture_run(path, rate=100.0, dispatch_ms=5.0, p95=9.0):
+    """A miniature train run in the real JSONL schema (kind/step/wall_time
+    + t_* phase fields + ingest_* fields + eval/final records)."""
+    records = []
+    for i in range(1, 9):
+        records.append({
+            "kind": "train", "step": 500 * i, "wall_time": 2.0 * i,
+            "learner_steps": 400 * i, "learner_steps_per_sec": rate + i,
+            "buffer_fill": 500 * i, "episode_return": -900.0 + 10 * i,
+            "critic_loss": 0.5, "mean_q": 1.0 + i,
+            "t_dispatch_ms": dispatch_ms, "n_dispatch": 50,
+            "t_dispatch_p50": dispatch_ms * 0.9,
+            "t_dispatch_p95": p95, "t_dispatch_max": p95 * 2,
+            "t_ingest_ms": 0.4, "n_ingest": 50,
+            "ingest_rows_per_sec": 8000.0, "ingest_ship_calls": 4,
+            "ingest_coalesce_mean": 2.0, "ingest_stall_ms": 0.0,
+            "ingest_queue_rows": 128,
+        })
+        if i % 4 == 0:
+            records.append({
+                "kind": "eval", "step": 500 * i, "wall_time": 2.0 * i + 0.5,
+                "eval_return": -800.0 + 50 * i,
+            })
+    records.append({
+        "kind": "final", "step": 4000, "wall_time": 17.0,
+        "learner_steps": 3200, "learner_steps_per_sec": rate,
+        "final_return": -600.0,
+    })
+    _write_jsonl(path, records)
+    return records
+
+
+def test_summarize_digest_and_render(tmp_path):
+    path = tmp_path / "run.jsonl"
+    _fixture_run(path)
+    digest = runs.summarize_run(str(path))
+    assert digest["records"] == {"train": 8, "eval": 2, "final": 1}
+    assert digest["steps"] == {"first": 500, "last": 4000}
+    assert digest["metrics"]["learner_steps_per_sec"]["last"] == 108.0
+    assert digest["phases"]["dispatch"]["p95_ms"] == 9.0
+    assert digest["phases"]["dispatch"]["calls"] == 400
+    assert digest["ingest"]["ingest_rows_per_sec"]["steady"] == 8000.0
+    assert digest["eval"]["best"] == -400.0
+    assert digest["final"]["final_return"] == -600.0
+    text = runs.render_summary(digest)
+    assert "dispatch" in text and "ingest_rows_per_sec" in text
+
+    # Interleaved non-JSON lines (echo streams mix prints into stdout
+    # captures) must be skipped, not fatal.
+    noisy = tmp_path / "noisy.jsonl"
+    noisy.write_text(
+        "resumed from ckpt at step 3\n"
+        + path.read_text()
+        + "{broken json\n"
+    )
+    assert runs.summarize_run(str(noisy))["records"]["train"] == 8
+
+
+def test_summarize_cli_smoke(tmp_path, capsys):
+    path = tmp_path / "run.jsonl"
+    _fixture_run(path)
+    assert runs.main(["summarize", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "phase breakdown" in out
+    assert runs.main(["summarize", "--json", str(path)]) == 0
+    digest = json.loads(capsys.readouterr().out)
+    assert digest["phases"]["dispatch"]["p95_ms"] == 9.0
+
+
+def test_compare_flags_regressions(tmp_path, capsys):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _fixture_run(a, rate=100.0, dispatch_ms=5.0, p95=9.0)
+    _fixture_run(b, rate=70.0, dispatch_ms=8.0, p95=30.0)  # slower + fatter tail
+    assert runs.main(["compare", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    rate_line = next(l for l in out.splitlines()
+                     if l.startswith("learner_steps_per_sec"))
+    assert "!" in rate_line  # >=5% worse, higher-is-better
+    p95_line = next(l for l in out.splitlines()
+                    if l.startswith("t_dispatch_p95"))
+    assert "!" in p95_line   # fatter tail flagged (lower-is-better)
+
+
+# --------------------------------------------------------------------------
+# gate (CI): exit nonzero on a synthetic 20% regression
+# --------------------------------------------------------------------------
+
+def _bench_json(path, value, dispatch_ms=1.0):
+    path.write_text(json.dumps({
+        "metric": "learner_grad_steps_per_sec",
+        "unit": "grad_steps/s",
+        "value": value,
+        "t_dispatch_ms": dispatch_ms,
+        "ingest_rows_per_sec": 8000.0,
+        "scaling_cpu_virtual": {
+            "scaled_batch": {"8": {"rows_per_sec": value * 64}}
+        },
+    }))
+
+
+def test_gate_passes_within_threshold(tmp_path):
+    _bench_json(tmp_path / "base.json", 100.0)
+    _bench_json(tmp_path / "cand.json", 95.0)  # -5% < 10% threshold
+    assert runs.main([
+        "gate", str(tmp_path / "base.json"), str(tmp_path / "cand.json"),
+    ]) == 0
+
+
+def test_gate_fails_on_20pct_grad_steps_regression(tmp_path, capsys):
+    """THE acceptance criterion: a synthetic 20% grad_steps_per_sec
+    (bench 'value') regression must exit nonzero at the default 10%
+    threshold."""
+    _bench_json(tmp_path / "base.json", 100.0)
+    _bench_json(tmp_path / "cand.json", 80.0)
+    rc = runs.main([
+        "gate", str(tmp_path / "base.json"), str(tmp_path / "cand.json"),
+    ])
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert "FAIL value" in out and "GATE FAIL" in out
+
+
+def test_gate_lower_is_better_and_dotted_keys(tmp_path):
+    _bench_json(tmp_path / "base.json", 100.0, dispatch_ms=1.0)
+    _bench_json(tmp_path / "cand.json", 100.0, dispatch_ms=1.5)
+    # dispatch latency +50%: fails only when gated lower-is-better.
+    assert runs.main([
+        "gate", str(tmp_path / "base.json"), str(tmp_path / "cand.json"),
+        "--keys", "value,-t_dispatch_ms",
+    ]) == 2
+    # Dotted path into the scaling curve gates nested values.
+    assert runs.main([
+        "gate", str(tmp_path / "base.json"), str(tmp_path / "cand.json"),
+        "--keys", "scaling_cpu_virtual.scaled_batch.8.rows_per_sec",
+    ]) == 0
+
+
+def test_gate_missing_candidate_key_fails(tmp_path):
+    """A metric that vanished from the candidate must FAIL (a silently
+    dropped field reading as healthy is how regressions hide)."""
+    _bench_json(tmp_path / "base.json", 100.0)
+    (tmp_path / "cand.json").write_text(json.dumps({"metric": "x"}))
+    assert runs.main([
+        "gate", str(tmp_path / "base.json"), str(tmp_path / "cand.json"),
+    ]) == 2
+
+
+def test_gate_unwraps_driver_bench_wrapper(tmp_path):
+    """BENCH_r*.json driver records embed the bench JSON in a 'tail'
+    string; gate must read through the wrapper."""
+    inner = {"metric": "x", "unit": "grad_steps/s", "value": 50.0}
+    (tmp_path / "base.json").write_text(json.dumps(
+        {"n": 5, "cmd": "python bench.py", "rc": 0,
+         "tail": "noise | more noise " + json.dumps(inner)}
+    ))
+    _bench_json(tmp_path / "cand.json", 49.0)
+    assert runs.main([
+        "gate", str(tmp_path / "base.json"), str(tmp_path / "cand.json"),
+    ]) == 0
+
+
+def test_module_entrypoint_runs_without_jax_import(tmp_path):
+    """`python -m distributed_ddpg_tpu.tools.runs` is the documented CLI;
+    it must work as a module AND must not initialize jax (instant start,
+    CI-safe on accelerator-less boxes) — asserted by poisoning the jax
+    import path."""
+    path = tmp_path / "run.jsonl"
+    _fixture_run(path)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.modules['jax'] = None\n"
+         "from distributed_ddpg_tpu.tools.runs import main\n"
+         f"sys.exit(main(['summarize', {str(path)!r}]))"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "phase breakdown" in proc.stdout

@@ -125,3 +125,8 @@ def test_device_replay_ingest_and_sample_chunk(tpu):
         "megakernel did not activate on real TPU: "
         f"{out.get('fused_chunk_error')}"
     )
+    # The native capture must carry the ingest breakdown (ROADMAP item:
+    # CPU sweeps had it, TPU captures dropped it) — these are the fields
+    # BENCH comparisons and tools.runs read.
+    assert out["ingest_ship_calls"] >= 1
+    assert out["ingest_rows_per_sec"] > 0

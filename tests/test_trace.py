@@ -1,0 +1,259 @@
+"""Flight-recorder tests (trace.py): ring semantics, Chrome trace-event
+export shape (what makes the file Perfetto-loadable), thread tagging, the
+<2% overhead guard, and the end-to-end train_jax integration — a traced
+CPU run must produce spans from >=3 distinct threads and JSONL records
+carrying t_dispatch_p95 (the PR's acceptance criteria)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu import trace
+from distributed_ddpg_tpu.trace import TraceRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    """Tests that enable the module singleton must not leak it into other
+    tests' hot paths (span() goes from no-op to recording)."""
+    yield
+    trace.disable()
+
+
+# --------------------------------------------------------------------------
+# recorder semantics
+# --------------------------------------------------------------------------
+
+def test_span_and_instant_export_shape(tmp_path):
+    rec = TraceRecorder(capacity=256)
+    with rec.span("work", n=3):
+        time.sleep(0.002)
+    rec.instant("marker", step=7)
+    path = tmp_path / "t.json"
+    n = rec.export(str(path))
+    assert n >= 3  # thread_name metadata + span + instant
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(spans) == 1 and len(instants) == 1
+    assert spans[0]["name"] == "work"
+    assert spans[0]["dur"] >= 2000  # microseconds
+    assert spans[0]["args"] == {"n": 3}
+    assert instants[0]["args"] == {"step": 7}
+    # Perfetto requirements: every event has pid/tid/ts; thread_name
+    # metadata names the track.
+    for e in spans + instants:
+        assert {"pid", "tid", "ts"} <= set(e)
+    assert metas and metas[0]["name"] == "thread_name"
+
+
+def test_ring_overwrites_oldest():
+    rec = TraceRecorder(capacity=16)
+    for i in range(100):
+        rec.instant(f"e{i}")
+    events = [e for e in rec.events() if e["ph"] == "i"]
+    assert len(events) <= 16
+    names = {e["name"] for e in events}
+    assert "e99" in names and "e0" not in names
+
+
+def test_window_filter():
+    rec = TraceRecorder(capacity=64)
+    rec.instant("old")
+    time.sleep(0.15)
+    rec.instant("new")
+    recent = [e for e in rec.events(window_s=0.1) if e["ph"] == "i"]
+    assert [e["name"] for e in recent] == ["new"]
+
+
+def test_complete_records_explicit_interval():
+    rec = TraceRecorder(capacity=64)
+    t0 = time.perf_counter()
+    rec.complete("stall", t0, 0.25, rows=64)
+    span = [e for e in rec.events() if e["ph"] == "X"][0]
+    assert span["name"] == "stall"
+    assert 240_000 <= span["dur"] <= 260_000  # ~250ms in us
+
+
+def test_threads_get_distinct_tids():
+    rec = TraceRecorder(capacity=256)
+
+    def work(tag):
+        with rec.span(tag):
+            time.sleep(0.01)
+
+    threads = [
+        threading.Thread(target=work, args=(f"w{i}",), name=f"tracer-{i}")
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with rec.span("main"):
+        pass
+    events = rec.events()
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len({e["tid"] for e in spans}) == 4
+    names = {
+        e["args"]["name"] for e in events if e.get("name") == "thread_name"
+    }
+    assert {"tracer-0", "tracer-1", "tracer-2"} <= names
+
+
+def test_disabled_module_api_is_noop(tmp_path):
+    trace.disable()
+    with trace.span("x"):
+        pass
+    trace.instant("y")
+    assert trace.export(str(tmp_path / "no.json")) == 0
+    assert not (tmp_path / "no.json").exists()
+
+
+def test_stall_report_artifacts(tmp_path):
+    trace.configure(capacity=128)
+    with trace.span("pre_stall_work"):
+        pass
+    paths = trace.stall_report(
+        str(tmp_path), reason="test stall", timeout_s=1.0,
+        extra={"beat": 42},
+    )
+    assert set(paths) == {"report", "trace"}
+    report = json.loads((tmp_path / trace.STALL_REPORT).read_text())
+    assert report["reason"] == "test stall"
+    assert report["beat"] == 42
+    me = [
+        t for t in report["threads"]
+        if t["name"] == threading.current_thread().name
+    ]
+    assert me and any("test_trace" in line for line in me[0]["stack"])
+    tr = json.loads((tmp_path / trace.STALL_TRACE).read_text())
+    assert any(
+        e.get("name") == "pre_stall_work" for e in tr["traceEvents"]
+    )
+
+
+# --------------------------------------------------------------------------
+# overhead guard (ISSUE satellite: recorder adds <2% to a CPU micro-loop)
+# --------------------------------------------------------------------------
+
+def test_trace_overhead_under_2_percent():
+    """An ENABLED recorder's span bracket must cost <2% of a realistic
+    hot-loop body (~0.5ms of numpy work — the scale of one small CPU
+    chunk dispatch). The two costs are measured SEPARATELY, min-over-
+    repeats: the per-span cost from a tight empty-span loop (~2us,
+    stable), the body from a plain loop — a subtraction of two noisy
+    ~20ms timings would make the guard flake on scheduler jitter (the
+    body jitters ~10x the span cost per iteration on a busy 1-core CI
+    box). Fails only on a real hot-path regression (e.g. someone adding
+    allocation, locking, or current_thread() back to _record)."""
+    trace.configure(capacity=65_536)
+    a = np.random.default_rng(0).standard_normal((160, 160)).astype(np.float32)
+
+    def span_cost_s() -> float:
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with trace.span("micro"):
+                pass
+        return (time.perf_counter() - t0) / n
+
+    def body_cost_s() -> float:
+        n = 50
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = a
+            for _ in range(6):
+                x = x @ a
+        return (time.perf_counter() - t0) / n
+
+    span_cost_s(), body_cost_s()  # warm BLAS pools + code paths
+    span = min(span_cost_s() for _ in range(3))
+    body = min(body_cost_s() for _ in range(5))
+    overhead = span / body
+    assert overhead < 0.02, (
+        f"tracing overhead {overhead:.2%} "
+        f"(span {span * 1e6:.2f}us vs body {body * 1e6:.1f}us)"
+    )
+
+
+# --------------------------------------------------------------------------
+# end-to-end: traced train run (PR acceptance criteria)
+# --------------------------------------------------------------------------
+
+def test_train_jax_traced_run_multithread_timeline(tmp_path):
+    """A short CPU train run with tracing on must produce a Perfetto-
+    loadable trace containing spans from >=3 distinct threads (learner
+    dispatch/ingest, ingest shipper, eval worker) and train JSONL records
+    carrying t_dispatch_p95 — the PR's acceptance criteria, kept tier-1.
+
+    Sizing: replay_min_size > block_size (1024) stages a full block during
+    warmup, and ~2000 post-warmup env steps stage another — in async mode
+    full blocks ship ONLY on the ingest-ship thread, so its traced span is
+    deterministic, not a race."""
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.train import train_jax
+
+    log_path = tmp_path / "train.jsonl"
+    cfg = DDPGConfig(
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=1,
+        # Train-kind records only log on the 50-chunk cadence (400 learner
+        # steps at chunk=8). A free-running actor burns the env budget
+        # during the first dispatch's multi-second XLA compile, ending the
+        # run after a handful of chunks — so pace ingest to the learner:
+        # with ratio 6, the budget (4000 - 1500 warmup)/6 ≈ 417 learner
+        # steps, deterministically past the 400-step log cadence.
+        total_env_steps=4_000,
+        replay_min_size=1_500,
+        replay_capacity=16_384,
+        max_ingest_ratio=6.0,
+        eval_every=600,
+        eval_episodes=1,
+        trace_dir=str(tmp_path),
+        log_path=str(log_path),
+    )
+    out = train_jax(cfg)
+    assert out["learner_steps"] > 0
+
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = doc["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    tid_names = {
+        e["tid"]: e["args"]["name"]
+        for e in events
+        if e.get("name") == "thread_name"
+    }
+    span_threads = {tid_names.get(e["tid"], "?") for e in spans}
+    assert len(span_threads) >= 3, (
+        f"expected spans from >=3 threads, got {sorted(span_threads)}"
+    )
+    assert "ingest-ship" in span_threads, sorted(span_threads)
+    span_names = {e["name"] for e in spans}
+    assert "dispatch" in span_names       # learner phase bracket
+    assert "ingest_ship" in span_names    # shipper thread
+    assert "eval_rollout" in span_names   # eval worker thread
+
+    train_recs = [
+        json.loads(line)
+        for line in log_path.read_text().splitlines()
+        if '"train"' in line
+    ]
+    assert any("t_dispatch_p95" in r for r in train_recs), (
+        "train JSONL records must carry reservoir tail latencies"
+    )
+
+    # The actor worker (separate process) exports its own per-process
+    # trace on clean exit; Perfetto merges the files by pid.
+    worker_trace = tmp_path / "trace_actor0.json"
+    assert worker_trace.exists()
+    wdoc = json.loads(worker_trace.read_text())
+    assert any(
+        e.get("name") == "actor_flush" for e in wdoc["traceEvents"]
+    )

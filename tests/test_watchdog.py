@@ -4,11 +4,13 @@ the train_jax wiring: the watchdog must fire on frozen progress, must NOT
 fire while progress advances or after stop(), and a watchdog-enabled
 training run must complete without a false positive."""
 
+import json
 import threading
 import time
 
 import pytest
 
+from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.watchdog import Watchdog
 
 
@@ -70,6 +72,96 @@ def test_grant_suppresses_firing_until_deadline():
 def test_rejects_nonpositive_timeout():
     with pytest.raises(ValueError):
         Watchdog(timeout_s=0.0, progress=lambda: 0)
+
+
+def test_stall_writes_report_and_trace_before_on_stall(tmp_path):
+    """The stall path must land stall_report.json (structured thread
+    stacks) AND stall_trace.json (the flight-recorder tail) BEFORE
+    on_stall runs — the default on_stall os._exits, so anything written
+    after it would never exist. Asserted by checking file presence FROM
+    INSIDE the on_stall override."""
+    trace.configure(capacity=256)
+    try:
+        with trace.span("pre_stall_phase"):
+            pass
+        seen = {}
+        fired = threading.Event()
+
+        def on_stall():
+            seen["report"] = (tmp_path / "stall_report.json").exists()
+            seen["trace"] = (tmp_path / "stall_trace.json").exists()
+            fired.set()
+
+        w = Watchdog(
+            timeout_s=0.3, progress=lambda: 0, on_stall=on_stall,
+            stall_dir=str(tmp_path),
+        ).start()
+        try:
+            assert fired.wait(timeout=2.0), "watchdog never fired"
+        finally:
+            w.stop()
+        assert seen == {"report": True, "trace": True}
+        assert set(w.stall_artifacts) == {"report", "trace"}
+
+        report = json.loads((tmp_path / "stall_report.json").read_text())
+        assert "no trainer progress" in report["reason"]
+        assert report["timeout_s"] == 0.3
+        assert report["last_progress_value"] == "0"
+        assert report["stalled_s"] >= 0.3
+        # The watchdog's own thread must be among the structured stacks
+        # (it is alive at dump time), and stacks must be real frames.
+        names = {t["name"] for t in report["threads"]}
+        assert "stall-watchdog" in names
+        assert all(t["stack"] for t in report["threads"])
+
+        tr = json.loads((tmp_path / "stall_trace.json").read_text())
+        assert any(
+            e.get("name") == "pre_stall_phase" for e in tr["traceEvents"]
+        )
+    finally:
+        trace.disable()
+
+
+def test_stall_report_without_tracing_still_written(tmp_path):
+    """Tracing off (the default for tests/interactive runs): the stall
+    path still writes the structured report — only the trace artifact is
+    skipped."""
+    trace.disable()
+    fired = threading.Event()
+    w = Watchdog(
+        timeout_s=0.3, progress=lambda: 0, on_stall=fired.set,
+        stall_dir=str(tmp_path),
+    ).start()
+    try:
+        assert fired.wait(timeout=2.0)
+    finally:
+        w.stop()
+    assert (tmp_path / "stall_report.json").exists()
+    assert not (tmp_path / "stall_trace.json").exists()
+    report = json.loads((tmp_path / "stall_report.json").read_text())
+    assert report["trace_events"] == 0
+
+
+def test_grant_suppression_with_stall_dir(tmp_path):
+    """grant() must keep suppressing the stall path with artifact writing
+    configured: no artifacts may appear during the grant window (a report
+    written for a suppressed stall would be a false alarm on disk), and
+    the artifacts + on_stall must both fire after it expires."""
+    fired = threading.Event()
+    w = Watchdog(
+        timeout_s=0.2, progress=lambda: 0, on_stall=fired.set,
+        stall_dir=str(tmp_path),
+    ).start()
+    try:
+        w.grant(1.2)
+        assert not fired.wait(timeout=0.8), "fired inside the grant window"
+        assert not (tmp_path / "stall_report.json").exists(), (
+            "stall artifacts written during an active grant"
+        )
+        assert fired.wait(timeout=2.0), "never fired after the grant expired"
+        assert (tmp_path / "stall_report.json").exists()
+    finally:
+        w.stop()
 
 
 def test_train_jax_with_watchdog_completes(tmp_path):

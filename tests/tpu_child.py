@@ -112,11 +112,18 @@ def _sample_chunk() -> dict:
     assert int(jax.device_get(lrn.state.step)) == K
     out2 = lrn.run_sample_chunk(rep)
     assert np.isfinite(float(out2.metrics["critic_loss"]))
+    # ingest_* observability fields ride the native capture (ROADMAP open
+    # item: CPU scaling sweeps carried them, TPU captures dropped them) —
+    # the REAL h2d ship cost is exactly the number the CPU sweeps can't
+    # measure. The snapshot must describe the 4 real 1024-row ships above.
+    ingest = rep.ingest_snapshot()
+    assert ingest["ingest_ship_calls"] >= 1, ingest
     return {
         "ok": True,
         "fused_chunk_active": lrn.fused_chunk_active,
         "fused_chunk_error": lrn.fused_chunk_error,
         "critic_loss": loss,
+        **ingest,
     }
 
 
