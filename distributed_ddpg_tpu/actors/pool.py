@@ -9,8 +9,19 @@ flow learner->workers through shared memory instead of gRPC pulls).
 - Transitions: workers push batched n-step transitions over an mp.Queue;
   `drain_into(replay)` moves them into the host replay buffer.
 - Failure detection (SURVEY.md §5): workers stamp heartbeats; `monitor()`
-  respawns any worker silent past the timeout (actors are stateless given
-  params, so a respawn is lossless except the in-flight episode).
+  respawns any worker that died, went silent past the heartbeat timeout,
+  or — config.actor_no_progress_s — kept heartbeating while producing
+  zero experience rows (the watchdog's documented actor-side blind spot).
+  Actors are stateless given params, so a respawn is lossless except the
+  in-flight episode. Respawns back off exponentially per slot, and a
+  crash-looping slot (config.quarantine_respawns failures within
+  config.quarantine_window_s) is QUARANTINED: the pool logs loudly, stops
+  respawning it, and training continues degraded — a respawn stampede of
+  doomed workers is strictly worse than one missing actor.
+- Fault injection (config.faults; faults.py): each worker receives its
+  slice of the run's FaultPlan at spawn time. One-shot faults arm only the
+  slot's FIRST incarnation (recovery must be observable); `crashloop`
+  re-arms every incarnation to drive the circuit breaker.
 
 Uses the 'spawn' start method: workers must never inherit the parent's JAX
 runtime state.
@@ -21,6 +32,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import queue as queue_mod
+import sys
 import time
 from typing import Dict, List, Optional
 
@@ -45,12 +57,17 @@ class ActorPool:
         config: DDPGConfig,
         spec: EnvSpec,
         num_actors: Optional[int] = None,
-        heartbeat_timeout: float = 30.0,
+        heartbeat_timeout: Optional[float] = None,
     ):
         self.config = config
         self.spec = spec
         self.num_actors = num_actors or config.num_actors
-        self.heartbeat_timeout = heartbeat_timeout
+        self.heartbeat_timeout = (
+            config.heartbeat_timeout_s
+            if heartbeat_timeout is None
+            else heartbeat_timeout
+        )
+        heartbeat_timeout = self.heartbeat_timeout
         if config.actor_throttle_s >= heartbeat_timeout:
             raise ValueError(
                 f"actor_throttle_s={config.actor_throttle_s} >= the pool's "
@@ -103,6 +120,23 @@ class ActorPool:
         self._procs: List[Optional[mp.Process]] = [None] * self.num_actors
         self._respawns = 0
         self._steps_received = 0
+        # --- supervised recovery state (one entry per worker slot) ---
+        self._plan = config.fault_plan()
+        self._broadcast_fault = self._plan.site("pool", "broadcast")
+        # pool:monitor:slow@k delays the k-th supervision pass — the
+        # "supervisor itself is slow" case: training must tolerate late
+        # failure detection, not just fast fault recovery.
+        self._monitor_fault = self._plan.site("pool", "monitor")
+        self._incarnation = [0] * self.num_actors
+        self._fail_times: List[List[float]] = [[] for _ in range(self.num_actors)]
+        self._backoff_until = [0.0] * self.num_actors
+        self._pending_respawn = [False] * self.num_actors
+        self._quarantined = [False] * self.num_actors
+        # Zero-rows detector clock: 0.0 = "no rows seen this incarnation";
+        # armed lazily at the first observed heartbeat (boot can take many
+        # seconds under cold-start contention, and the detector must not
+        # count boot time as silence).
+        self._last_rows_t = [0.0] * self.num_actors
         # Env-step progress restored from a checkpoint (set by the driver
         # BEFORE start()): counts against the uniform-warmup budget so a
         # resumed run doesn't re-inject warmup_uniform random actions.
@@ -131,12 +165,10 @@ class ActorPool:
         return (remaining + self.num_actors - 1) // self.num_actors
 
     def _spawn(self, worker_id: int) -> None:
-        fault_step = 0
-        if self.config.inject_fault.startswith("actor:"):
-            # "actor:<id>:<step>" — crash worker <id> at env step <step>.
-            _, wid, step = self.config.inject_fault.split(":")
-            if int(wid) == worker_id:
-                fault_step = int(step)
+        fault_specs = self._plan.for_worker(
+            worker_id, incarnation=self._incarnation[worker_id]
+        )
+        self._incarnation[worker_id] += 1
         p = self._ctx.Process(
             target=run_worker,
             kwargs=dict(
@@ -162,7 +194,7 @@ class ActorPool:
                 ou_dt=self.config.ou_dt,
                 n_step=self.config.n_step,
                 gamma=self.config.gamma,
-                fault_step=fault_step,
+                fault_specs=fault_specs,
                 throttle_s=self.config.actor_throttle_s,
                 gaussian_policy=self.config.sac,
                 log_std_min=self.config.sac_log_std_min,
@@ -194,6 +226,7 @@ class ActorPool:
         # was self-sustaining (every respawn re-created the boot stampede
         # that caused the timeout).
         self._heartbeat[worker_id] = 0.0
+        self._last_rows_t[worker_id] = 0.0  # re-armed at first heartbeat
         self._procs[worker_id] = p
 
     def start(self, actor_params) -> "ActorPool":
@@ -223,6 +256,7 @@ class ActorPool:
 
         `learner_step` stamps which learner step these params come from so
         experience can be attributed a staleness (see staleness())."""
+        self._broadcast_fault.tick()
         with trace.span("param_broadcast", learner_step=int(learner_step)):
             flat = flatten_params(actor_params)
             view = np.frombuffer(self._shared, dtype=np.float32)
@@ -237,6 +271,8 @@ class ActorPool:
     def _note_version(self, worker_id: int, version: int) -> None:
         acted_at = self._version_steps.get(version, 0)
         self._staleness[worker_id] = self._last_broadcast_step - acted_at
+        # Rows arrived from this worker: feed the zero-rows detector.
+        self._last_rows_t[worker_id] = time.time()
 
     def staleness(self) -> Dict[str, float]:
         """Learner-step staleness of the params behind each worker's most
@@ -350,30 +386,102 @@ class ActorPool:
     # --- failure detection / elastic recovery (SURVEY.md §5) ---
 
     def monitor(self) -> Dict[str, int]:
-        """Respawn workers that died or went silent. Call periodically."""
+        """Supervise the worker fleet. Call periodically. Detects three
+        failure shapes — death, heartbeat silence, and (when
+        config.actor_no_progress_s > 0) heartbeating-but-zero-rows — and
+        respawns through a per-slot exponential backoff; a slot failing
+        config.quarantine_respawns times inside quarantine_window_s is
+        quarantined instead of respawned (crash-loop circuit breaker)."""
+        self._monitor_fault.tick()
+        cfg = self.config
         now = time.time()
         respawned = 0
         for i, p in enumerate(self._procs):
-            dead = p is None or not p.is_alive()
-            # heartbeat == 0 means the worker never finished booting (see
-            # _spawn) — the silent timeout is not armed yet; real deaths
-            # are caught by the liveness check above regardless.
-            silent = (
-                self._heartbeat[i] > 0.0
-                and now - self._heartbeat[i] > self.heartbeat_timeout
-            )
-            if dead or silent:
+            if self._quarantined[i]:
+                continue
+            if not self._pending_respawn[i]:
+                why = self._detect_failure(i, p, now)
+                if why is None:
+                    continue
                 if p is not None and p.is_alive():
                     p.terminate()
                     p.join(timeout=2.0)
+                self._procs[i] = None
+                window = [
+                    t for t in self._fail_times[i]
+                    if now - t <= cfg.quarantine_window_s
+                ]
+                window.append(now)
+                self._fail_times[i] = window
+                if (
+                    cfg.quarantine_respawns > 0
+                    and len(window) >= cfg.quarantine_respawns
+                ):
+                    self._quarantined[i] = True
+                    trace.instant("actor_quarantined", worker=i, why=why,
+                                  failures=len(window))
+                    print(
+                        f"[pool] QUARANTINED worker {i}: {len(window)} "
+                        f"failures (last: {why}) within "
+                        f"{cfg.quarantine_window_s:.0f}s — respawns "
+                        "suspended, training continues degraded on "
+                        f"{self.num_actors - self.quarantined_count} "
+                        "workers",
+                        file=sys.stderr, flush=True,
+                    )
+                    continue
+                backoff = min(
+                    cfg.respawn_backoff_s * (2.0 ** (len(window) - 1)),
+                    cfg.respawn_backoff_max_s,
+                )
+                self._backoff_until[i] = now + backoff
+                self._pending_respawn[i] = True
+                trace.instant("actor_respawn", worker=i, why=why,
+                              backoff_s=round(backoff, 3))
+            if self._pending_respawn[i] and now >= self._backoff_until[i]:
+                self._pending_respawn[i] = False
                 self._respawns += 1
                 respawned += 1
-                trace.instant(
-                    "actor_respawn", worker=i,
-                    why=("dead" if dead else "silent"),
-                )
                 self._spawn(i)
-        return {"respawned": respawned, "total_respawns": self._respawns}
+        return {
+            "respawned": respawned,
+            "total_respawns": self._respawns,
+            "quarantined": self.quarantined_count,
+        }
+
+    def _detect_failure(self, i: int, p, now: float) -> Optional[str]:
+        """One worker slot's health check; returns the failure kind or
+        None. heartbeat == 0 means the worker never finished booting (see
+        _spawn) — the silent timeout and the zero-rows detector are not
+        armed yet; real deaths are caught regardless."""
+        if p is None or not p.is_alive():
+            return "dead"
+        hb = self._heartbeat[i]
+        if hb <= 0.0:
+            return None
+        if now - hb > self.heartbeat_timeout:
+            return "silent"
+        no_progress_s = self.config.actor_no_progress_s
+        if no_progress_s > 0.0:
+            if self._last_rows_t[i] == 0.0:
+                # First heartbeat seen with no rows yet: start the clock
+                # here, not at spawn — boot time is not production time.
+                self._last_rows_t[i] = now
+            elif now - self._last_rows_t[i] > no_progress_s:
+                return "no_rows"
+        return None
+
+    @property
+    def quarantined_count(self) -> int:
+        return sum(self._quarantined)
+
+    def recovery_counters(self) -> Dict[str, int]:
+        """Cumulative fault-history counters for the metrics JSONL
+        (train.py logs them; tools.runs summarize surfaces them)."""
+        return {
+            "actor_respawns": self._respawns,
+            "actor_quarantined": self.quarantined_count,
+        }
 
     @property
     def steps_received(self) -> int:

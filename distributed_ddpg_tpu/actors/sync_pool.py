@@ -245,4 +245,9 @@ class SyncActorPool:
         return out
 
     def monitor(self) -> Dict[str, int]:
-        return {"respawned": 0, "total_respawns": 0}
+        return {"respawned": 0, "total_respawns": 0, "quarantined": 0}
+
+    def recovery_counters(self) -> Dict[str, int]:
+        # Inline actors cannot crash independently of the driver; the
+        # counters exist for JSONL-schema parity with ActorPool.
+        return {"actor_respawns": 0, "actor_quarantined": 0}

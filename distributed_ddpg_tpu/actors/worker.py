@@ -7,8 +7,12 @@ back in batches over an mp.Queue and stamps a heartbeat every loop so the
 pool's monitor can respawn it if it dies (SURVEY.md §5 'Failure detection';
 the reference has none — a dead TF worker just stalls).
 
-Workers never import jax (see policy.py). `fault_step > 0` makes the worker
-crash at that env step — the fault-injection hook (config.inject_fault).
+Workers never import jax (see policy.py). `fault_specs` is this worker's
+slice of the run's FaultPlan (config.faults; faults.py) — (kind, at_step,
+duration_s) tuples applied inline: `crash` raises, `hang` freezes WITHOUT
+heartbeats (the silent-timeout respawn path), `stall` keeps heartbeating
+but produces nothing (the pool monitor's zero-rows detector), `slow`
+throttles env stepping for a bounded window.
 """
 
 from __future__ import annotations
@@ -42,7 +46,7 @@ def run_worker(
     n_step: int,
     gamma: float,
     send_every: int = 32,
-    fault_step: int = 0,
+    fault_specs=(),         # (kind, at_step, duration_s) tuples, sorted by step
     throttle_s: float = 0.0,
     gaussian_policy: bool = False,  # SAC: sample the policy, no OU noise
     log_std_min: float = -5.0,
@@ -203,6 +207,48 @@ def run_worker(
                 pass
         pending.clear()
 
+    # --- scripted faults (faults.py; see module docstring) ---
+    faults = sorted(fault_specs, key=lambda t: t[1])
+    fault_i = 0
+    slow_until, slow_sleep = 0, 0.0
+    hung = False
+
+    def _freeze(stamp_heartbeat: bool) -> None:
+        """Injected hang/stall: park until the pool terminates this process
+        (the recovery under test) or a clean stop/orphaning ends the run.
+        `hang` parks WITHOUT heartbeats — the silent-timeout respawn path;
+        `stall` keeps stamping them while producing nothing — the zero-rows
+        detector path (pool.monitor)."""
+        while not stop_flag.value:
+            if parent_pid and os.getppid() != parent_pid:
+                return
+            if stamp_heartbeat:
+                heartbeat[worker_id] = time.time()
+            time.sleep(0.05)
+
+    def apply_faults(step: int) -> bool:
+        """Fire faults due at `step`; returns True if the worker must exit
+        (it was hung/stalled and released by stop/orphaning)."""
+        nonlocal fault_i, slow_until, slow_sleep
+        while fault_i < len(faults) and faults[fault_i][1] <= step:
+            kind, _, dur = faults[fault_i]
+            fault_i += 1
+            if kind == "crash":
+                raise RuntimeError(
+                    f"injected crash in worker {worker_id} at step {step}"
+                )
+            if kind in ("hang", "stall"):
+                _freeze(stamp_heartbeat=(kind == "stall"))
+                return True
+            if kind == "slow":
+                from distributed_ddpg_tpu.faults import SLOW_FAULT_STEPS
+
+                slow_until = step + SLOW_FAULT_STEPS
+                slow_sleep = dur
+        if step < slow_until and slow_sleep > 0.0:
+            time.sleep(slow_sleep)
+        return False
+
     maybe_refresh()
     obs, _ = env.reset(seed=seed)
     noise.reset()
@@ -250,8 +296,9 @@ def run_worker(
         total_steps += 1
         obs = next_obs
 
-        if fault_step and total_steps >= fault_step:
-            raise RuntimeError(f"injected fault in worker {worker_id}")
+        if apply_faults(total_steps):
+            hung = True  # parked by an injected hang/stall, then released
+            break
 
         if terminated or truncated:
             # Flush the truncation tail through the accumulator so no
@@ -275,8 +322,10 @@ def run_worker(
             flush()
 
     # Orphaned workers skip the final flush (its backpressure would block
-    # forever on the dead drainer) but still try to land their trace.
-    if not orphaned:
+    # forever on the dead drainer) but still try to land their trace; so do
+    # workers released from an injected hang/stall — their in-flight rows
+    # are the "lost on crash" loss the fault is simulating.
+    if not orphaned and not hung:
         flush()
     if trace_dir:
         try:

@@ -11,6 +11,15 @@ Here a checkpoint is the COMPLETE learner-side state:
 Saves go through a throwaway directory + atomic rename via orbax's own
 finalization, and happen off the hot loop (call cadence is
 config.checkpoint_every).
+
+Robustness (docs/RESILIENCE.md): every successful save also writes
+`manifest_<step>.json` — per-file sizes + a cheap head/tail crc32 — so
+restore can verify a checkpoint BEFORE handing it to orbax. Writes retry
+with exponential backoff on OSError (`retries=`, wired from
+config.ckpt_write_retries; injectable via a faults.FaultSite). Restore
+with no explicit step walks the retained checkpoints newest-first and
+falls back past any that fail verification or fail to load — a corrupt or
+half-written latest checkpoint costs one cadence of progress, not the run.
 """
 
 from __future__ import annotations
@@ -19,6 +28,10 @@ import dataclasses
 import json
 import math
 import os
+import shutil
+import sys
+import time
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -96,15 +109,13 @@ def _steps(directory: str):
 
 
 def _prune(directory: str, keep: int, current: int) -> None:
-    """Delete old step_*/config_* pairs, retaining the newest `keep` —
-    ALWAYS including `current`, the checkpoint that just landed: sorting
-    alone would delete the fresh save when the directory holds
+    """Delete old step_*/config_*/manifest_* triples, retaining the newest
+    `keep` — ALWAYS including `current`, the checkpoint that just landed:
+    sorting alone would delete the fresh save when the directory holds
     higher-numbered stale checkpoints from a previous run (the
     --resume=false reuse workflow check_config_compatible suggests).
     Runs on the writer thread after a successful save; best-effort (a
     failed unlink must not fail the save that just landed)."""
-    import shutil
-
     if keep <= 0:
         return
     others = [s for s in _steps(directory) if s != current]
@@ -112,16 +123,122 @@ def _prune(directory: str, keep: int, current: int) -> None:
         try:
             shutil.rmtree(os.path.join(directory, f"step_{old}"),
                           ignore_errors=True)
-            cfg_path = os.path.join(directory, f"config_{old}.json")
-            if os.path.exists(cfg_path):
-                os.unlink(cfg_path)
+            for side in (f"config_{old}.json", f"manifest_{old}.json"):
+                side_path = os.path.join(directory, side)
+                if os.path.exists(side_path):
+                    os.unlink(side_path)
         except OSError:
             pass
 
 
-def _write(directory: str, step: int, ckpt: Dict[str, Any],
-           config: Optional[DDPGConfig], keep: int = KEEP_CHECKPOINTS) -> str:
+# --- integrity manifest (restore-time verification) -----------------------
+
+# Digest window per file: crc32 over the first and last MiB + the size.
+# A full-stream hash of a ~3 GB replay checkpoint would add seconds to
+# every save; head+tail+size catches the real-world corruptions (truncated
+# write, zeroed header, wrong-length file) at microsecond cost.
+_DIGEST_CAP = 1 << 20
+
+
+def _digest_file(path: str) -> Tuple[int, int]:
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        crc = zlib.crc32(f.read(_DIGEST_CAP))
+        if size > _DIGEST_CAP:
+            f.seek(max(size - _DIGEST_CAP, _DIGEST_CAP))
+            crc = zlib.crc32(f.read(_DIGEST_CAP), crc)
+    return size, crc
+
+
+def _write_manifest(directory: str, step: int) -> None:
+    """Record every file under step_<step> with size + head/tail crc32.
+    Written AFTER orbax finalizes (the atomic rename), so a manifest's
+    existence certifies 'this checkpoint finished writing'; its contents
+    let restore detect post-finalize corruption."""
+    root = os.path.join(directory, f"step_{step}")
+    files: Dict[str, Any] = {}
+    for dirpath, _, names in os.walk(root):
+        for name in sorted(names):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            size, crc = _digest_file(full)
+            files[rel] = [size, crc]
+    path = os.path.join(directory, f"manifest_{step}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": step, "files": files}, f)
+    os.replace(tmp, path)
+
+
+def verify_checkpoint(directory: str, step: int) -> Tuple[bool, str]:
+    """Cheap integrity check of one retained checkpoint against its
+    manifest. Returns (ok, why). A checkpoint written before manifests
+    existed verifies as ok ('no manifest') — the orbax restore itself is
+    the backstop for those; restore()'s fallback chain catches its
+    failure too."""
+    directory = os.path.abspath(directory)
+    root = os.path.join(directory, f"step_{step}")
+    if not os.path.isdir(root):
+        return False, "missing checkpoint directory"
+    mpath = os.path.join(directory, f"manifest_{step}.json")
+    if not os.path.exists(mpath):
+        return True, "no manifest (pre-manifest checkpoint)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        entries = manifest["files"]
+    except (OSError, json.JSONDecodeError, KeyError, TypeError) as e:
+        return False, f"unreadable manifest: {e!r}"
+    for rel, (size, crc) in entries.items():
+        full = os.path.join(root, rel)
+        try:
+            got_size, got_crc = _digest_file(full)
+        except OSError:
+            return False, f"missing/unreadable file {rel}"
+        if got_size != size:
+            return False, f"size mismatch {rel}: {got_size} != {size}"
+        if got_crc != crc:
+            return False, f"digest mismatch {rel}"
+    return True, "ok"
+
+
+def _quarantine_corrupt(directory: str, step: int) -> None:
+    """Move a verification-failed checkpoint out of the step_N namespace
+    (-> corrupt_step_N) so a resumed run that re-reaches step N can write
+    a fresh checkpoint there — orbax refuses to overwrite an existing
+    destination, and without this the corrupt leftovers would fail every
+    later save at that step. Renamed, not deleted: the payload stays on
+    disk for forensics. Best-effort (fallback must proceed regardless)."""
+    directory = os.path.abspath(directory)
+    src = os.path.join(directory, f"step_{step}")
+    dst = os.path.join(directory, f"corrupt_step_{step}")
+    try:
+        if os.path.isdir(dst):
+            shutil.rmtree(dst, ignore_errors=True)
+        os.rename(src, dst)
+        for side in (f"manifest_{step}.json", f"config_{step}.json"):
+            side_path = os.path.join(directory, side)
+            if os.path.exists(side_path):
+                os.unlink(side_path)
+        print(
+            f"[checkpoint] quarantined corrupt step_{step} -> "
+            f"corrupt_step_{step}",
+            file=sys.stderr, flush=True,
+        )
+    except OSError:
+        pass
+
+
+def _write_once(directory: str, step: int, ckpt: Dict[str, Any],
+                config: Optional[DDPGConfig],
+                keep: int = KEEP_CHECKPOINTS) -> str:
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    # A leftover directory at this step (a corrupt checkpoint restore
+    # skipped, or a prior attempt whose sidecar write failed) would make
+    # orbax refuse the save; this writer is the single authority for the
+    # step, so clear it.
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, ckpt)
     if config is not None:
@@ -134,8 +251,47 @@ def _write(directory: str, step: int, ckpt: Dict[str, Any],
         }
         with open(os.path.join(os.path.dirname(path), f"config_{step}.json"), "w") as f:
             json.dump(fields, f, indent=2, default=list)
+    _write_manifest(os.path.dirname(path), step)
     _prune(os.path.dirname(path), keep, step)
     return path
+
+
+def _write(directory: str, step: int, ckpt: Dict[str, Any],
+           config: Optional[DDPGConfig], keep: int = KEEP_CHECKPOINTS,
+           retries: int = 0, backoff_s: float = 0.5,
+           fault=None) -> Tuple[str, int]:
+    """Write with bounded retry + exponential backoff on OSError (full
+    disk blips, NFS hiccups, injected ckpt:write:ioerror faults). Returns
+    (path, retries_used). `fault` is a faults.FaultSite ticked once per
+    ATTEMPT — retries advance the ordinal, so 'ioerror@2' scripts 'the
+    second attempt overall fails'."""
+    from distributed_ddpg_tpu import trace
+
+    for attempt in range(retries + 1):
+        try:
+            if fault is not None:
+                fault.tick()
+            return _write_once(directory, step, ckpt, config, keep=keep), attempt
+        except OSError as e:
+            # A failed attempt may leave a partially-finalized step dir
+            # (or a completed dir whose sidecar write failed) — clear it
+            # so the retry's orbax save starts clean.
+            shutil.rmtree(
+                os.path.join(os.path.abspath(directory), f"step_{step}"),
+                ignore_errors=True,
+            )
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2.0 ** attempt)
+            trace.instant("ckpt_write_retry", step=step,
+                          attempt=attempt + 1)
+            print(
+                f"[checkpoint] write of step_{step} failed ({e!r}); "
+                f"retry {attempt + 1}/{retries} in {delay:.2f}s",
+                file=sys.stderr, flush=True,
+            )
+            time.sleep(delay)
+    raise AssertionError("unreachable")
 
 
 def save(
@@ -147,14 +303,23 @@ def save(
     env_steps: int = 0,
     v_bounds=None,
     keep: int = KEEP_CHECKPOINTS,
+    retries: int = 0,
+    backoff_s: float = 0.5,
+    fault=None,
 ) -> str:
-    """Write checkpoint `directory/step_N` synchronously. Returns the path."""
-    return _write(
+    """Write checkpoint `directory/step_N` synchronously. Returns the path.
+    `retries`/`backoff_s` bound the OSError retry loop (_write); `fault`
+    is an optional faults.FaultSite for the chaos harness."""
+    path, _ = _write(
         directory, step,
         _snapshot(step, state, replay, env_steps, v_bounds=v_bounds),
         config,
         keep=keep,
+        retries=retries,
+        backoff_s=backoff_s,
+        fault=fault,
     )
+    return path
 
 
 class AsyncSaver:
@@ -174,6 +339,9 @@ class AsyncSaver:
         self._thread: Optional[threading.Thread] = None
         self.skipped = 0
         self.errors: list = []
+        # Cumulative OSError retries consumed by background writes — the
+        # `ckpt_write_retries` recovery counter train.py logs.
+        self.write_retries = 0
 
     @property
     def busy(self) -> bool:
@@ -189,6 +357,9 @@ class AsyncSaver:
         env_steps: int = 0,
         v_bounds=None,
         keep: int = KEEP_CHECKPOINTS,
+        retries: int = 0,
+        backoff_s: float = 0.5,
+        fault=None,
     ) -> bool:
         """Snapshot now, write in the background. Returns False (and skips)
         if the previous write is still in flight."""
@@ -205,7 +376,12 @@ class AsyncSaver:
 
                 try:
                     with trace.span("ckpt_write", step=step):
-                        _write(directory, step, ckpt, config, keep=keep)
+                        _, used = _write(
+                            directory, step, ckpt, config, keep=keep,
+                            retries=retries, backoff_s=backoff_s,
+                            fault=fault,
+                        )
+                    self.write_retries += used
                 except Exception as e:  # surfaced via .errors / wait()
                     self.errors.append(e)
 
@@ -286,11 +462,57 @@ def restore(
     is given, the checkpoint's saved config is validated against it first.
     `meta_out`, when given, is filled with the checkpoint's extra metadata
     (currently: "v_bounds" — the resolved auto-support bounds, present only
-    on checkpoints from auto-support runs)."""
+    on checkpoints from auto-support runs).
+
+    With `step=None` the retained checkpoints are walked NEWEST-FIRST and
+    any that fails manifest verification (verify_checkpoint) or fails to
+    load is skipped with a loud stderr note — a corrupt or half-written
+    latest checkpoint costs one cadence of progress, not the run. An
+    explicit `step` restores exactly that step (no fallback); a config
+    incompatibility always raises (it is a contract violation, not
+    corruption)."""
     if step is None:
-        step = latest_step(directory)
-        if step is None:
+        candidates = (
+            _steps(os.path.abspath(directory))
+            if os.path.isdir(directory) else []
+        )
+        if not candidates:
             raise FileNotFoundError(f"no checkpoints under {directory}")
+        failures = []
+        for s in sorted(candidates, reverse=True):
+            ok, why = verify_checkpoint(directory, s)
+            if not ok:
+                print(
+                    f"[checkpoint] step_{s} failed verification ({why}); "
+                    "falling back to the previous retained checkpoint",
+                    file=sys.stderr, flush=True,
+                )
+                failures.append(f"step_{s}: {why}")
+                _quarantine_corrupt(directory, s)
+                continue
+            # Config compatibility is checked HERE, outside the load
+            # try/except, so its ValueError raises through (a contract
+            # violation, not corruption) while a ValueError from orbax's
+            # own load (tree mismatch on a subtly-corrupt checkpoint that
+            # passed the crc spot-check) still falls back.
+            if config is not None:
+                check_config_compatible(directory, s, config)
+            try:
+                return restore(
+                    directory, state_template, replay=replay, step=s,
+                    config=None, meta_out=meta_out,
+                )
+            except Exception as e:
+                print(
+                    f"[checkpoint] step_{s} failed to load ({e!r}); "
+                    "falling back to the previous retained checkpoint",
+                    file=sys.stderr, flush=True,
+                )
+                failures.append(f"step_{s}: load error: {e!r}")
+        raise RuntimeError(
+            f"no restorable checkpoint under {directory}; tried newest-"
+            "first: " + "; ".join(failures)
+        )
     if config is not None:
         check_config_compatible(directory, step, config)
     path = os.path.join(os.path.abspath(directory), f"step_{step}")

@@ -252,10 +252,53 @@ class DDPGConfig:
     # Ring capacity in events; at steady state ~4 events per learner chunk
     # + shipper/eval activity, 65536 holds tens of minutes of timeline.
     trace_events: int = 65_536
-    inject_fault: str = ""           # fault-injection hook (SURVEY.md §5)
+
+    # --- fault injection & supervised recovery (docs/RESILIENCE.md) ---
+    # Deterministic fault schedule (faults.FaultPlan grammar), e.g.
+    # --faults='worker:2:crash@5000;worker:0:hang@8000;ckpt:write:ioerror@2'
+    # — scripts crashes/hangs/slowdowns/IO errors into actor workers, the
+    # ingest shipper, the prefetcher, and the checkpoint writer. Replaces
+    # the old one-shot --inject_fault hook (its 'actor:<id>:<step>' form
+    # still parses, as a worker crash). "" = no faults (production).
+    faults: str = ""
+    # Pool monitor: respawn a worker silent past this many seconds
+    # (actors/pool.py heartbeats — SURVEY.md §5 'Failure detection').
+    heartbeat_timeout_s: float = 30.0
+    # Actor-side blind spot (watchdog.py coverage note): respawn a worker
+    # that HEARTBEATS but has produced zero experience rows for this many
+    # seconds. 0 = off — the default, because legitimate zero-row windows
+    # (very long episodes with n-step holdback, heavy backpressure) are
+    # config-dependent; chaos runs and production fleets should set it to
+    # a few multiples of the expected flush interval.
+    actor_no_progress_s: float = 0.0
+    # Respawn backoff: the k-th recent failure of the SAME worker slot
+    # waits min(base * 2^(k-1), max) seconds before the respawn — a
+    # crash-looping worker must not be respawned in a tight loop (every
+    # respawn re-pays cold-start cost and can itself re-trigger the
+    # boot stampede the heartbeat sentinel exists for).
+    respawn_backoff_s: float = 0.5
+    respawn_backoff_max_s: float = 30.0
+    # Crash-loop circuit breaker: this many failures of the same slot
+    # within quarantine_window_s quarantines the slot — the pool logs
+    # loudly, stops respawning it, and training continues degraded on the
+    # remaining workers (SURVEY.md §5; a stampede of doomed respawns is
+    # strictly worse than one missing actor). 0 = breaker off.
+    quarantine_respawns: int = 5
+    quarantine_window_s: float = 60.0
+    # Checkpoint write retry (checkpoint.py): transient IO failures retry
+    # up to this many times with exponential backoff before surfacing.
+    ckpt_write_retries: int = 2
+    ckpt_retry_backoff_s: float = 0.5
 
     def replace(self, **kwargs) -> "DDPGConfig":
         return dataclasses.replace(self, **kwargs)
+
+    def fault_plan(self):
+        """The parsed (seeded) FaultPlan for this run. Parsed on demand —
+        validation already ran in __post_init__, so this cannot raise."""
+        from distributed_ddpg_tpu.faults import FaultPlan
+
+        return FaultPlan.parse(self.faults, seed=self.seed)
 
     def resolved_warmup_uniform(self) -> int:
         """Global uniform-warmup env-step budget (see warmup_uniform_steps:
@@ -295,8 +338,15 @@ class DDPGConfig:
                     str(field.type), str
                 )
                 parser.add_argument(f"--{field.name}", type=ftype, default=field.default)
-        args = parser.parse_args(argv)
-        return cls(**vars(args))
+        # Deprecated alias (pre-chaos-harness scripts): --inject_fault's
+        # 'actor:<id>:<step>' one-shot crash folds into the --faults plan,
+        # whose grammar accepts the legacy form directly.
+        parser.add_argument("--inject_fault", type=str, default="")
+        args = vars(parser.parse_args(argv))
+        legacy = args.pop("inject_fault")
+        if legacy:
+            args["faults"] = ";".join(filter(None, [args["faults"], legacy]))
+        return cls(**args)
 
     @property
     def v_support_auto(self) -> bool:
@@ -459,6 +509,25 @@ class DDPGConfig:
             )
         if self.param_refresh_interval_s < 0:
             raise ValueError("param_refresh_interval_s must be >= 0")
+        # Fail fast on fault-grammar typos: a bad spec must die at config
+        # parse, not hours later when the fault was scheduled to fire.
+        from distributed_ddpg_tpu.faults import FaultPlan
+
+        FaultPlan.parse(self.faults, seed=self.seed)
+        if self.heartbeat_timeout_s <= 0:
+            raise ValueError("heartbeat_timeout_s must be > 0")
+        if self.actor_no_progress_s < 0:
+            raise ValueError("actor_no_progress_s must be >= 0 (0 = off)")
+        if self.respawn_backoff_s < 0 or self.respawn_backoff_max_s < 0:
+            raise ValueError("respawn backoff values must be >= 0")
+        if self.quarantine_respawns < 0:
+            raise ValueError("quarantine_respawns must be >= 0 (0 = off)")
+        if self.quarantine_window_s <= 0:
+            raise ValueError("quarantine_window_s must be > 0")
+        if self.ckpt_write_retries < 0:
+            raise ValueError("ckpt_write_retries must be >= 0")
+        if self.ckpt_retry_backoff_s < 0:
+            raise ValueError("ckpt_retry_backoff_s must be >= 0")
         if self.trace_events < 16:
             raise ValueError("trace_events must be >= 16")
         if self.transport not in ("auto", "shm", "queue"):

@@ -1,0 +1,265 @@
+"""Deterministic fault injection: a seeded `FaultPlan` scripted from config
+(`--faults='worker:2:crash@5000;worker:0:hang@8000;ckpt:write:ioerror@2'`)
+that drives crashes, hangs, slowdowns, and IO errors into every recoverable
+component — actor workers, the pool monitor's respawn path, the async
+ingest shipper, the ChunkPrefetcher, and the checkpoint writer.
+
+Why scripted, not random: D4PG-scale fleets (arXiv 1804.08617) and
+Podracer-style scheduling (arXiv 2104.06272) treat preemption and partial
+failure as the NORMAL operating mode, so the recovery paths must be
+exercised continuously — and a recovery bug is only debuggable if the
+fault schedule that provoked it replays exactly. Every fault fires at a
+deterministic trigger point (an env step for workers, a call ordinal for
+host-side sites); the plan `seed` only fills in durations left unspecified,
+drawn from a PRNG seeded per-fault so the same spec string + seed always
+yields the same schedule.
+
+Grammar (';'-separated specs):
+
+    spec      := component [':' target] ':' kind '@' at ['~' seconds]
+    component := worker | pool | shipper | prefetch | ckpt
+    kind      := crash | crashloop | hang | stall | slow | ioerror
+
+`at` is 1-based: for `worker` it is the env step inside that worker's
+FIRST incarnation (a respawned worker gets a clean slate — except
+`crashloop`, which re-arms on every incarnation to drive the pool's
+crash-loop circuit breaker); for host-side sites it is the n-th call to
+the instrumented operation. `~seconds` sets the duration of `slow`/`hang`
+faults (default: seeded draw, see `_default_duration`).
+
+Fault semantics by component:
+
+    worker:<id>:crash@N      raise at env step N (kills the process)
+    worker:<id>:crashloop@N  crash at local step N of EVERY incarnation
+    worker:<id>:hang@N       freeze WITHOUT heartbeats (silent-timeout path)
+    worker:<id>:stall@N      keep heartbeating, produce nothing (the
+                             watchdog blind spot pool.monitor now covers)
+    worker:<id>:slow@N~S     sleep S per env step for SLOW_FAULT_STEPS steps
+    ckpt:write:ioerror@K     K-th checkpoint write attempt raises IOError
+    ckpt:write:slow@K~S      K-th write attempt sleeps S first
+    shipper:ship:crash@K     K-th ingest ship raises (thread-restart path)
+    shipper:ship:slow@K~S    K-th ingest ship sleeps S first
+    prefetch:sample:hang@K~S K-th prefetch sample sleeps S (PrefetchTimeout
+                             territory when S exceeds next()'s deadline)
+    pool:broadcast:slow@K~S  K-th param broadcast sleeps S first
+
+The legacy one-shot hook `--inject_fault=actor:<id>:<step>` is accepted as
+an alias for `worker:<id>:crash@<step>`.
+
+Host-side consumers hold a `FaultSite` (`plan.site(component, target)`)
+and call `site.tick()` once per instrumented operation; worker processes
+receive their (picklable) fault tuples via `plan.for_worker(id)` and apply
+them inline (actors/worker.py). An empty plan's `tick()` is a no-op
+attribute check — safe to leave on every production call site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt")
+KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror")
+
+# Worker `slow` faults throttle this many consecutive env steps, then lift
+# — bounded so a chaos soak keeps making progress past the fault.
+SLOW_FAULT_STEPS = 200
+
+# Worker-only kinds need a process to kill/freeze; site-only kinds need a
+# call site that can raise/sleep inline.
+_WORKER_KINDS = ("crash", "crashloop", "hang", "stall", "slow")
+_SITE_KINDS = ("crash", "hang", "slow", "ioerror")
+
+
+class InjectedFault(OSError):
+    """A scripted fault from a FaultPlan. Subclasses OSError so recovery
+    paths written for real IO failures (checkpoint write retry) treat an
+    injected failure exactly like the genuine article."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    component: str
+    target: str      # worker id as str, or site name ("write", "ship", ...)
+    kind: str
+    at: int          # env step (worker) / 1-based call ordinal (site)
+    duration_s: float  # slow/hang duration; resolved at parse time
+
+    def describe(self) -> str:
+        tgt = f":{self.target}" if self.target else ""
+        return f"{self.component}{tgt}:{self.kind}@{self.at}"
+
+
+def _default_duration(kind: str, rng: random.Random) -> float:
+    """Seeded default durations: slowdowns are sub-second hiccups, hangs
+    are long enough to trip the timeouts they target (worker hangs ignore
+    this — they freeze until terminated)."""
+    if kind == "slow":
+        return round(rng.uniform(0.05, 0.25), 3)
+    if kind == "hang":
+        return round(rng.uniform(2.0, 5.0), 3)
+    return 0.0
+
+
+class FaultPlan:
+    """An immutable, seeded schedule of FaultSpecs plus the factory for
+    per-component injectors. Parse once (config validation does, to fail
+    fast on typos), share everywhere."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):
+        self.specs: Tuple[FaultSpec, ...] = tuple(specs)
+        self.seed = seed
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan([{'; '.join(s.describe() for s in self.specs)}])"
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        """Parse the --faults grammar. Raises ValueError with the offending
+        spec named — config.__post_init__ calls this so a typo dies at
+        argument parsing, not at fault-fire time mid-run."""
+        specs: List[FaultSpec] = []
+        text = (text or "").strip()
+        if not text:
+            return cls((), seed=seed)
+        for i, raw in enumerate(s.strip() for s in text.split(";")):
+            if not raw:
+                continue
+            # str seeds hash via sha512 — deterministic across interpreters
+            # (tuple seeding is deprecated and PYTHONHASHSEED-dependent).
+            rng = random.Random(f"{seed}:{i}:{raw}")
+            specs.append(_parse_one(raw, rng))
+        return cls(specs, seed=seed)
+
+    def for_worker(self, worker_id: int, incarnation: int = 0) -> List[Tuple[str, int, float]]:
+        """Picklable (kind, at_step, duration_s) tuples for one worker
+        process. First incarnation gets every scheduled fault; respawns get
+        only `crashloop` (re-armed as a plain crash) so recovery is
+        observable — a one-shot crash must not re-fire forever."""
+        out = []
+        for s in self.specs:
+            if s.component != "worker" or s.target != str(worker_id):
+                continue
+            if s.kind == "crashloop":
+                out.append(("crash", s.at, s.duration_s))
+            elif incarnation == 0:
+                out.append((s.kind, s.at, s.duration_s))
+        return sorted(out, key=lambda t: t[1])
+
+    def site(self, component: str, target: str = "") -> "FaultSite":
+        matches = [
+            s for s in self.specs
+            if s.component == component and (not s.target or not target or s.target == target)
+        ]
+        return FaultSite(matches, component, target)
+
+
+def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
+    def bad(why: str) -> ValueError:
+        return ValueError(
+            f"bad fault spec {raw!r}: {why} (grammar: "
+            "component[:target]:kind@at[~seconds], e.g. "
+            "'worker:2:crash@5000' or 'ckpt:write:ioerror@2')"
+        )
+
+    parts = raw.split(":")
+    if len(parts) == 3 and parts[0] == "actor" and "@" not in parts[2]:
+        # Legacy --inject_fault alias: actor:<id>:<step> == crash.
+        try:
+            wid, step = int(parts[1]), int(parts[2])
+        except ValueError:
+            raise bad("legacy actor:<id>:<step> needs two integers") from None
+        return FaultSpec("worker", str(wid), "crash", step, 0.0)
+    if len(parts) == 2:
+        component, tail = parts[0], parts[1]
+        target = ""
+    elif len(parts) == 3:
+        component, target, tail = parts
+    else:
+        raise bad("expected 2 or 3 ':'-separated fields")
+    if component not in COMPONENTS:
+        raise bad(f"unknown component {component!r} (one of {COMPONENTS})")
+    if "@" not in tail:
+        raise bad("missing '@<at>' trigger")
+    kind, _, at_part = tail.partition("@")
+    if kind not in KINDS:
+        raise bad(f"unknown kind {kind!r} (one of {KINDS})")
+    duration: Optional[float] = None
+    if "~" in at_part:
+        at_str, _, dur_str = at_part.partition("~")
+        try:
+            duration = float(dur_str)
+        except ValueError:
+            raise bad(f"bad duration {dur_str!r}") from None
+        if duration < 0:
+            raise bad("duration must be >= 0")
+    else:
+        at_str = at_part
+    try:
+        at = int(at_str)
+    except ValueError:
+        raise bad(f"bad trigger {at_str!r} (integer step/ordinal)") from None
+    if at < 1:
+        raise bad("trigger must be >= 1")
+    if component == "worker":
+        if kind not in _WORKER_KINDS:
+            raise bad(f"kind {kind!r} does not apply to workers")
+        try:
+            int(target)
+        except ValueError:
+            raise bad("worker target must be an integer id") from None
+    else:
+        if kind not in _SITE_KINDS:
+            raise bad(f"kind {kind!r} only applies to workers")
+    if duration is None:
+        duration = _default_duration(kind, rng)
+    return FaultSpec(component, target, kind, at, duration)
+
+
+class FaultSite:
+    """Call-ordinal injector for one host-side component: `tick()` once per
+    instrumented operation; the n-th tick fires every spec scheduled
+    `@n` — `ioerror`/`crash` raise InjectedFault, `slow`/`hang` sleep their
+    duration. Thread-safe (sites sit on shipper/prefetch/ckpt threads)."""
+
+    def __init__(self, specs: Sequence[FaultSpec], component: str, target: str = ""):
+        self._by_at: Dict[int, List[FaultSpec]] = {}
+        for s in specs:
+            self._by_at.setdefault(s.at, []).append(s)
+        self.component = component
+        self.target = target
+        self._count = 0
+        self._lock = threading.Lock()
+        self.fired: List[str] = []
+
+    def __bool__(self) -> bool:
+        return bool(self._by_at)
+
+    @property
+    def calls(self) -> int:
+        return self._count
+
+    def tick(self) -> None:
+        if not self._by_at:
+            return
+        with self._lock:
+            self._count += 1
+            due = self._by_at.get(self._count, ())
+        for s in due:
+            self.fired.append(s.describe())
+            if s.kind in ("slow", "hang"):
+                time.sleep(s.duration_s)
+            else:  # ioerror / crash
+                raise InjectedFault(
+                    f"injected {s.describe()} (call #{self._count})"
+                )
+
+
+# Shared empty site: the no-plan fast path every production call site holds.
+NULL_SITE = FaultSite((), "", "")

@@ -40,12 +40,18 @@ class ChunkPrefetcher:
         chunk_size: int,
         depth: int = 2,
         lock: Optional[threading.Lock] = None,
+        fault=None,                 # faults.FaultSite ticked per sample
     ):
         self._replay = replay
         self._put = put_chunk
         self._batch_size = batch_size
         self._chunk = chunk_size
         self._lock = lock or threading.Lock()
+        # Chaos harness (faults.py): prefetch:sample:hang@k~s sleeps the
+        # k-th chunk sample (PrefetchTimeout territory when s exceeds
+        # next()'s deadline); prefetch:sample:crash@k kills the worker
+        # thread, surfacing via next()'s 'prefetch thread died'.
+        self._fault = fault
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -60,6 +66,8 @@ class ChunkPrefetcher:
         # thread — when the learner's sample_wait phase grows, the
         # timeline shows whether THIS (lock contention, sample cost) or
         # the h2d below is the bottleneck.
+        if self._fault is not None:
+            self._fault.tick()
         with trace.span("prefetch_sample"):
             samples = []
             with self._lock:

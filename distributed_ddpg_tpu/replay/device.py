@@ -125,6 +125,7 @@ class DeviceReplay:
         async_ship: bool = False,
         max_coalesce: int = 8,
         staging_blocks: int = 16,
+        fault=None,
     ):
         self.capacity = int(capacity)
         self.obs_dim = obs_dim
@@ -158,6 +159,13 @@ class DeviceReplay:
         self._staging = threading.Condition()
         self.dispatch_lock = threading.RLock()
         self._stats = IngestStats()
+        # Chaos harness (faults.py): an optional FaultSite ticked once per
+        # ship dispatch — shipper:ship:slow@k sleeps, shipper:ship:crash@k
+        # raises (killing the shipper thread, which _check_shipper then
+        # restarts — the supervised-recovery path under test).
+        self._fault = fault
+        self._shipper_restarts = 0
+        self._max_shipper_restarts = 3
 
         donate = partial(
             jax.jit,
@@ -264,8 +272,11 @@ class DeviceReplay:
     def ingest_snapshot(self) -> dict:
         """Interval ingest observability fields (metrics.IngestStats):
         rows/sec shipped, ship calls, coalesce factor, producer stall
-        time, queue depth — emitted into train/bench records."""
-        return self._stats.snapshot(pending_rows=self.pending_rows)
+        time, queue depth — emitted into train/bench records. The shipper
+        restart count (cumulative, recovery path) rides along."""
+        out = self._stats.snapshot(pending_rows=self.pending_rows)
+        out["ingest_shipper_restarts"] = self._shipper_restarts
+        return out
 
     def close(self) -> None:
         """Stop the background shipper (if any); subsequent add_packed
@@ -279,8 +290,28 @@ class DeviceReplay:
     # --- host -> HBM ingestion ---
 
     def _check_shipper(self) -> None:
+        """Surface — or recover from — a dead shipper thread. The shipper
+        is stateless between ships (staged rows stay in the ring until a
+        pop commits to a dispatch... except the in-flight super-block a
+        crash mid-ship loses, bounded by max_coalesce * block_size rows),
+        so a bounded number of restarts is safe; past the cap the failure
+        is structural and must surface."""
         s = self._shipper
         if s is not None and s.exc is not None:
+            if self._shipper_restarts < self._max_shipper_restarts:
+                self._shipper_restarts += 1
+                exc, s.exc = s.exc, None
+                trace.instant("shipper_restart", n=self._shipper_restarts)
+                import sys
+
+                print(
+                    f"[ingest] shipper thread died ({exc!r}); restarting "
+                    f"({self._shipper_restarts}/"
+                    f"{self._max_shipper_restarts})",
+                    file=sys.stderr, flush=True,
+                )
+                self._shipper = _IngestShipper(self).start()
+                return
             raise IngestError("ingest shipper thread died") from s.exc
 
     def _coalesce_k(self, n_blocks: int, cap_blocks: int) -> int:
@@ -505,6 +536,8 @@ class DeviceReplay:
         return fn
 
     def _ship_global(self, local_rows: np.ndarray, k: int = 1) -> None:
+        if self._fault is not None:
+            self._fault.tick()
         block = jax.make_array_from_process_local_data(
             self._block_sharding,
             np.ascontiguousarray(local_rows, np.float32),
@@ -515,6 +548,8 @@ class DeviceReplay:
         )
 
     def _ship(self, chunk: np.ndarray) -> None:
+        if self._fault is not None:
+            self._fault.tick()
         if self._mesh is not None:
             chunk = jax.device_put(
                 chunk, NamedSharding(self._mesh, P(None, None))

@@ -150,6 +150,17 @@ KEY_METRICS = (
     "mean_q",
 )
 
+# Cumulative recovery counters (train.py recovery_fields; docs/RESILIENCE.md)
+# — the run's fault history. `last` is the total; a nonzero anywhere means
+# the run survived at least one injected or real failure.
+RECOVERY_KEYS = (
+    "actor_respawns",
+    "actor_quarantined",
+    "ckpt_write_retries",
+    "emergency_ckpt",
+    "ingest_shipper_restarts",
+)
+
 
 def summarize_run(path: str) -> Dict[str, Any]:
     """Machine-readable digest of one JSONL run (the CLI renders it; tests
@@ -205,6 +216,13 @@ def summarize_run(path: str) -> Dict[str, Any]:
             ingest[key] = {"steady": _tail_mean(vals), "max": max(vals)}
     digest["ingest"] = ingest
 
+    recovery = {}
+    for key in RECOVERY_KEYS:
+        vals = _col(train + final, key)
+        if vals:
+            recovery[key] = {"last": vals[-1], "max": max(vals)}
+    digest["recovery"] = recovery
+
     ev = _col(evals, "eval_return")
     if ev:
         digest["eval"] = {
@@ -255,6 +273,16 @@ def render_summary(digest: Dict[str, Any]) -> str:
                 for k, v in digest["ingest"].items()
             ],
         ))
+    if digest.get("recovery"):
+        rec = digest["recovery"]
+        if any(v["max"] for v in rec.values()):
+            out.append("\n-- recovery / fault history (cumulative)")
+            out.append(render_table(
+                ["counter", "total"],
+                [[k, v["last"]] for k, v in rec.items()],
+            ))
+        else:
+            out.append("\n-- recovery: clean run (all counters zero)")
     if digest.get("eval"):
         e = digest["eval"]
         out.append(
@@ -308,6 +336,10 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
         ib = b["ingest"].get(key, {})
         add(key, ia.get("steady"), ib.get("steady"),
             lower_better=("stall" in key or "queue" in key or "_ms" in key))
+    for key in sorted(set(a.get("recovery", {})) | set(b.get("recovery", {}))):
+        ra = a.get("recovery", {}).get(key, {})
+        rb = b.get("recovery", {}).get(key, {})
+        add(key, ra.get("last"), rb.get("last"), lower_better=True)
     ea, eb = a.get("eval", {}), b.get("eval", {})
     add("eval_best", ea.get("best"), eb.get("best"))
     fa, fb = a.get("final", {}), b.get("final", {})

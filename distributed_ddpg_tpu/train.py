@@ -34,6 +34,13 @@ from distributed_ddpg_tpu.ops import support_auto
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
 
+# Exit-code contract (docs/RESILIENCE.md): a supervising driver must be
+# able to tell "preempted, resumable" from a real failure. SIGTERM during
+# train_jax takes one emergency checkpoint and the CLI exits
+# EXIT_PREEMPTED (EX_TEMPFAIL) — distinct from the stall watchdog's 70
+# (EX_SOFTWARE, wedged device) and from ordinary crash tracebacks.
+EXIT_PREEMPTED = 75
+
 
 def _enable_faulthandler() -> None:
     """Stack dumps on demand (kill -USR1 <pid>) and on hard faults — a
@@ -429,6 +436,17 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     from distributed_ddpg_tpu.types import pack_batch_np
 
     is_multi = multihost.initialize()
+    # --- chaos harness + preemption (docs/RESILIENCE.md) ---
+    # The fault plan is parsed once; each recoverable component gets its
+    # own call-site injector. SIGTERM flips a flag the loop polls at chunk
+    # boundaries: the run takes ONE emergency checkpoint off the hot loop
+    # and returns with summary["preempted"] set (main() exits
+    # EXIT_PREEMPTED so drivers can tell "resumable" from "crashed").
+    fault_plan = config.fault_plan()
+    ckpt_fault = fault_plan.site("ckpt", "write") if fault_plan else None
+    preempt = threading.Event()
+    emergency_ckpt = [0]
+
     env = make(config.env_id, seed=config.seed)
     spec = spec_of(env)
     chunk = resolve_learner_chunk(config)
@@ -473,6 +491,26 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             "the run would livelock at startup. Lower learner_chunk or "
             "raise replay_min_size."
         )
+    # SIGTERM handler: installed after the fail-fast config checks above
+    # (an early ValueError must not leak the handler — its restore lives
+    # in the teardown finally below) and before the first long-running
+    # stage, so preemption covers learner construction and warmup too.
+    import signal
+
+    def _on_sigterm(*_):
+        preempt.set()
+        print(
+            "[train] SIGTERM: finishing the in-flight chunk, taking an "
+            f"emergency checkpoint, exiting {EXIT_PREEMPTED} (resumable)",
+            file=sys.stderr, flush=True,
+        )
+
+    prev_sigterm = None
+    try:
+        prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not on the main thread (embedded callers): no handler
+
     learner = ShardedLearner(
         config,
         spec.obs_dim,
@@ -500,6 +538,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 config.ingest_async and not is_multi and not config.strict_sync
             ),
             max_coalesce=config.ingest_coalesce,
+            fault=(
+                fault_plan.site("shipper", "ship") if fault_plan else None
+            ),
         )
         device_replay = (
             DevicePrioritizedReplay(
@@ -571,6 +612,17 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     phases = PhaseTimers()
     saver = ckpt_lib.AsyncSaver()
     last_ckpt = learn_steps
+
+    def recovery_fields() -> Dict[str, int]:
+        """Cumulative fault-history counters for every train/final record
+        (ISSUE: actor_respawns / actor_quarantined / ckpt_write_retries /
+        emergency_ckpt) — `tools.runs summarize` renders them as the run's
+        recovery digest."""
+        return {
+            **pool.recovery_counters(),
+            "ckpt_write_retries": saver.write_retries,
+            "emergency_ckpt": emergency_ckpt[0],
+        }
     eval_policy = NumpyPolicy(
         param_layout(
             spec.obs_dim,
@@ -714,6 +766,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     last_eval = 0
     last_refresh_t = 0.0
     last_log_t = 0.0
+    # Fleet supervision cadence. Monitor must run on WALL CLOCK, not on
+    # learner progress: with a rate cap armed, a fully-dead fleet freezes
+    # learn_steps between log-cadence multiples, and a monitor called only
+    # from the log gate would never run again — no respawns, run wedged
+    # (observed live: crash+hang killed both workers during the first
+    # compile; the learner sprinted to its cap and froze one chunk short
+    # of the next 400-multiple).
+    last_monitor_t = 0.0
     support_controller = support_auto.SupportController()
 
     def after_chunk(out, indices) -> None:
@@ -814,6 +874,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 buffer_fill=buffer_fill(),
                 episode_return=mean_ret,
                 **pool.staleness(),
+                **recovery_fields(),
                 **chunk_metrics,
                 **support_metrics,
                 **phases.snapshot(),
@@ -854,6 +915,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                         else None
                     ),
                     keep=config.checkpoint_keep,
+                    retries=config.ckpt_write_retries,
+                    backoff_s=config.ckpt_retry_backoff_s,
+                    fault=ckpt_fault,
                 )
             last_ckpt = learn_steps
 
@@ -892,7 +956,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 )
 
         warm_it = 0
-        while buffer_fill() < min_fill:
+        while buffer_fill() < min_fill and not preempt.is_set():
             # Lockstep warmup ingest: loop count is driven by the
             # globally-replicated buffer size and `warm_it` advances
             # identically everywhere, so every process calls sync_ship
@@ -930,7 +994,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             warm_it += 1
 
         trace.instant("warmup_done", buffer_fill=buffer_fill())
-        if config.distributional and learner.config.v_support_auto:
+        if (
+            config.distributional and learner.config.v_support_auto
+            and not preempt.is_set()  # partial warmup: no stats to size from
+        ):
             # C51 auto-support (ops/support_auto.py): size [v_min, v_max]
             # from the warmup replay's (n-step) reward statistics. Gated on
             # the LEARNER's config: a resume that restored checkpointed
@@ -948,10 +1015,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             )
 
         prefetch = None
-        if not use_device_replay:
+        if not use_device_replay and not preempt.is_set():
             prefetch = ChunkPrefetcher(
                 replay, learner.put_chunk, learner.global_batch, chunk,
                 depth=config.prefetch_depth, lock=replay_lock,
+                fault=(
+                    fault_plan.site("prefetch", "sample")
+                    if fault_plan else None
+                ),
             ).start()
 
         # Rates below report the steady state, not compile/warmup time.
@@ -977,8 +1048,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             cached_global = 0
             last_budget = -1
             first_dispatch_done = False
-            while True:
+            while not preempt.is_set():
                 _beat()
+                # Wall-clock fleet supervision (see last_monitor_t note):
+                # every iteration reaches this, including the rate-capped
+                # ingest spin below — a dead fleet respawns even when the
+                # learner cannot advance.
+                if time.monotonic() - last_monitor_t >= 1.0:
+                    last_monitor_t = time.monotonic()
+                    pool.monitor()
                 if is_multi:
                     if it % 10 == 0:
                         cached_global = global_env_steps()
@@ -1069,7 +1147,60 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
 
         if prefetch is not None:
             prefetch.stop()
+
+        if preempt.is_set():
+            # --- emergency checkpoint (preemption contract) ---
+            # One save OFF the hot loop, then a normal teardown. The
+            # in-flight cadence write (if any) lands first; its failure
+            # must not cost the emergency save. Same-step dedupe: if the
+            # cadence already wrote exactly learn_steps, that checkpoint
+            # IS the resumable state.
+            _beat()
+            try:
+                saver.wait()
+            except Exception as e:
+                print(
+                    f"[train] in-flight checkpoint write failed during "
+                    f"preemption ({e!r}); writing the emergency "
+                    "checkpoint anyway",
+                    file=sys.stderr, flush=True,
+                )
+                saver.errors.clear()
+            if config.checkpoint_dir and jax.process_index() == 0:
+                if ckpt_lib.latest_step(config.checkpoint_dir) != learn_steps:
+                    with phases.phase("ckpt"):
+                        ckpt_lib.save(
+                            config.checkpoint_dir, learn_steps,
+                            learner.state,
+                            device_replay if use_device_replay else replay,
+                            config,
+                            env_steps=env_steps(),
+                            v_bounds=(
+                                (learner.config.v_min, learner.config.v_max)
+                                if config.distributional
+                                and config.v_support_auto
+                                else None
+                            ),
+                            keep=config.checkpoint_keep,
+                            retries=config.ckpt_write_retries,
+                            backoff_s=config.ckpt_retry_backoff_s,
+                            fault=ckpt_fault,
+                        )
+                emergency_ckpt[0] = 1
+                trace.instant("emergency_ckpt", step=learn_steps)
+                print(
+                    f"[train] emergency checkpoint at learner step "
+                    f"{learn_steps} (env step {env_steps()}) — resumable",
+                    file=sys.stderr, flush=True,
+                )
     finally:
+        if prev_sigterm is not None:
+            try:
+                import signal as _signal
+
+                _signal.signal(_signal.SIGTERM, prev_sigterm)
+            except (ValueError, TypeError):
+                pass
         _beat()  # each teardown stage gets a fresh watchdog allowance
         pool.stop()
         _beat()
@@ -1086,15 +1217,21 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             t.join(timeout=60)
 
     # --- final eval with the trained policy (CPU, deterministic) ---
+    # Skipped under preemption: the contract is "checkpoint and get out";
+    # whole CPU eval episodes would hold the exit for seconds.
     _beat()
-    eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
-    final_return = _eval_numpy(eval_policy, config, spec)
+    if preempt.is_set():
+        final_return = None
+    else:
+        eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
+        final_return = _eval_numpy(eval_policy, config, spec)
     rate = learn_timer.rate()
     log.log(
         "final", env_steps(),
         learner_steps=learn_steps,
         learner_steps_per_sec=rate,
         final_return=final_return,
+        **recovery_fields(),
         **phases.snapshot(),
     )
     log.close()
@@ -1112,6 +1249,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         "learner_steps": learn_steps,
         "final_return": final_return,
         "param_checksum": checksum,
+        "preempted": preempt.is_set(),
+        **recovery_fields(),
     }
 
 
@@ -1137,6 +1276,10 @@ def main(argv=None) -> None:
     config = DDPGConfig.from_flags(argv if argv is not None else sys.argv[1:])
     summary = train(config)
     print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})
+    if summary.get("preempted"):
+        # The documented "preempted, resumable" exit — a driver retries
+        # the run with the same checkpoint_dir instead of diagnosing it.
+        sys.exit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

@@ -36,12 +36,16 @@ params d2h — both observed wedge points).
 
 Coverage note: this watchdog catches LEARNER-side wedges (device calls
 that never return). An actor-side stall — workers heartbeating but
-producing no experience — is the one hang it cannot see, because the
-warmup/cap loops beat every iteration whether or not rows moved; train.py
-closes that gap with a secondary deadline (no ingest for 10x watchdog_s
-raises a loud RuntimeError on the healthy learner thread). The first
-post-warmup dispatch gets a one-time `grant()` so its XLA compile isn't
-killed as a false stall."""
+producing no experience — is invisible to it, because the warmup/cap
+loops beat every iteration whether or not rows moved. That blind spot is
+covered twice over: PER-WORKER by the pool monitor's zero-rows detector
+(config.actor_no_progress_s — a worker that heartbeats but delivers no
+rows past the threshold is respawned through the same backoff/quarantine
+path as a dead one; actors/pool.py), and FLEET-WIDE by train.py's
+secondary deadline (no ingest at all for 10x watchdog_s raises a loud
+RuntimeError on the healthy learner thread). The first post-warmup
+dispatch gets a one-time `grant()` so its XLA compile isn't killed as a
+false stall."""
 
 from __future__ import annotations
 

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# CI bench-regression gate (ROADMAP open item; docs/OBSERVABILITY.md §3):
+# compare a candidate bench JSON against a baseline — by default the
+# newest BENCH_r*.json in the repo root that actually RESOLVES the gate
+# keys (driver rounds whose bench run died at the TPU probe leave wrapper
+# JSONs with no bench object; gating against one would SKIP every key and
+# silently pass any regression) — and exit 2 on regression past the
+# threshold, so the driver's round loop can fail fast on a
+# perf-regressing change. Exits 1 if no baseline resolves the keys.
+#
+# Usage:
+#   scripts/ci_gate.sh <candidate.json> [baseline.json]
+#   THRESHOLD=0.15 KEYS='value,-t_dispatch_ms' scripts/ci_gate.sh cand.json
+#
+# Environment:
+#   THRESHOLD  allowed relative regression (default 0.10)
+#   KEYS       comma-separated gate keys; '-' prefix = lower-is-better
+#              (default: value — the headline learner-steps/sec ratio)
+set -euo pipefail
+
+repo_root="$(cd "$(dirname "$0")/.." && pwd)"
+candidate="${1:?usage: ci_gate.sh <candidate.json> [baseline.json]}"
+baseline="${2:-}"
+keys="${KEYS:-value}"
+
+# Pick (or validate) the baseline: it must resolve at least one gate key,
+# else the gate would be a silent no-op (every key SKIPped = GATE PASS).
+baseline="$(
+    GATE_KEYS="$keys" GATE_BASELINE="$baseline" \
+    python - "$repo_root" <<'PY'
+import glob, os, sys
+
+sys.path.insert(0, sys.argv[1])
+from distributed_ddpg_tpu.tools.runs import _lookup, load_bench
+
+keys = [k.lstrip("-") for k in os.environ["GATE_KEYS"].split(",") if k]
+
+
+def usable(path):
+    try:
+        obj = load_bench(path)
+    except Exception:
+        return False
+    return any(
+        isinstance(_lookup(obj, k), (int, float))
+        and not isinstance(_lookup(obj, k), bool)
+        for k in keys
+    )
+
+
+explicit = os.environ["GATE_BASELINE"]
+if explicit:
+    if not usable(explicit):
+        print(
+            f"ci_gate: baseline {explicit} resolves none of the gate keys "
+            f"{keys} — the gate would silently pass; refusing",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print(explicit)
+    sys.exit(0)
+
+# BENCH_r<NN>.json: zero-padded rounds, so lexicographic sort is round order.
+for path in sorted(glob.glob(os.path.join(sys.argv[1], "BENCH_r*.json")),
+                   reverse=True):
+    if usable(path):
+        print(path)
+        sys.exit(0)
+print(
+    f"ci_gate: no BENCH_r*.json in {sys.argv[1]} resolves the gate keys "
+    f"{keys}", file=sys.stderr,
+)
+sys.exit(1)
+PY
+)"
+
+echo "ci_gate: baseline=$baseline candidate=$candidate" \
+     "threshold=${THRESHOLD:-0.10} keys=$keys"
+exec python -m distributed_ddpg_tpu.tools.runs gate \
+    "$baseline" "$candidate" \
+    --threshold "${THRESHOLD:-0.10}" \
+    --keys "$keys"

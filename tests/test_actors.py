@@ -120,7 +120,7 @@ def test_pool_streams_transitions_and_respawns(transport):
     if transport == "shm" and not native.available():
         pytest.skip("native toolchain unavailable")
     cfg, spec, state = _setup(
-        num_actors=2, inject_fault="actor:0:200", transport=transport
+        num_actors=2, faults="worker:0:crash@200", transport=transport
     )
     replay = UniformReplay(cfg.replay_capacity, spec.obs_dim, spec.act_dim)
     import jax

@@ -1,0 +1,230 @@
+"""Chaos harness integration tests (docs/RESILIENCE.md; SURVEY.md §4
+'Fault/elastic' taken to production grade): a CPU training run under a
+scripted multi-fault schedule must keep making learner progress, end
+resumable, and resume; a corrupted latest checkpoint must fall back to the
+previous retained one through the REAL train_jax resume path; SIGTERM must
+produce an emergency checkpoint and the documented exit code (75)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_ddpg_tpu import checkpoint as ckpt_lib
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.train import EXIT_PREEMPTED, train_jax
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _records(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip().startswith("{"):
+                out.append(json.loads(line))
+    return out
+
+
+def test_chaos_soak_multi_fault_schedule(tmp_path):
+    """The headline soak: three distinct fault kinds — worker crash, worker
+    hang (silent-heartbeat path), checkpoint write IO error — scripted into
+    one short CPU run. The run must complete its env budget (progress
+    after every fault), recover each worker through the backoff respawn
+    path, absorb the write failure via retry, and leave a VALID latest
+    checkpoint a second run resumes from."""
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1",
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=2,
+        total_env_steps=4_000,
+        replay_min_size=256,
+        replay_capacity=20_000,
+        eval_every=0,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=200,
+        log_path=str(tmp_path / "chaos.jsonl"),
+        # 1:1 rate caps = the reference's synchronous schedule: learner and
+        # ingest advance together at the throttled actor rate, so the run
+        # lasts long enough for every scheduled fault to fire AND recover.
+        max_learn_ratio=1.0,
+        max_ingest_ratio=1.0,
+        actor_throttle_s=0.004,
+        # Fast supervision for test time; production defaults are 30/0.5/30.
+        heartbeat_timeout_s=2.0,
+        respawn_backoff_s=0.05,
+        respawn_backoff_max_s=0.5,
+        ckpt_write_retries=2,
+        ckpt_retry_backoff_s=0.05,
+        faults=(
+            "worker:0:crash@300"      # process death -> liveness respawn
+            ";worker:1:hang@600"      # frozen, no heartbeats -> silent respawn
+            ";ckpt:write:ioerror@1"   # first write attempt fails -> retry
+        ),
+    )
+    out = train_jax(cfg)
+
+    # The env budget completed: learner progress continued after each fault
+    # (a dead fleet or a wedged writer would have stalled the run instead).
+    assert out["learner_steps"] > 0
+    assert out["actor_respawns"] >= 2, (
+        f"crash + hang should both respawn: {out}"
+    )
+    assert out["actor_quarantined"] == 0
+    assert out["ckpt_write_retries"] >= 1, (
+        f"injected ckpt ioerror was never retried: {out}"
+    )
+    assert not out["preempted"]
+
+    # Learner kept advancing after the fleet faults fired.
+    recs = _records(cfg.log_path)
+    trains = [r for r in recs if r["kind"] == "train"]
+    faulted = [r for r in trains if r.get("actor_respawns", 0) >= 1]
+    if faulted:
+        assert out["learner_steps"] > faulted[0]["learner_steps"], (
+            "no learner progress after the first respawn"
+        )
+    final = [r for r in recs if r["kind"] == "final"][-1]
+    assert final["actor_respawns"] == out["actor_respawns"]
+    assert final["ckpt_write_retries"] == out["ckpt_write_retries"]
+
+    # A valid (manifest-verified) checkpoint landed despite the IO fault...
+    step = ckpt_lib.latest_step(cfg.checkpoint_dir)
+    assert step is not None and step > 0
+    ok, why = ckpt_lib.verify_checkpoint(cfg.checkpoint_dir, step)
+    assert ok, why
+
+    # ...and a fresh run resumes from it (fault-free this time).
+    cfg2 = cfg.replace(
+        faults="",
+        total_env_steps=cfg.total_env_steps + 600,
+        log_path=str(tmp_path / "resume.jsonl"),
+    )
+    out2 = train_jax(cfg2)
+    assert out2["learner_steps"] >= step, (
+        f"resume started below the checkpointed step {step}: {out2}"
+    )
+
+
+def test_corrupt_latest_checkpoint_resume_falls_back(tmp_path, capfd):
+    """Acceptance: a run with a corrupted LATEST checkpoint restores from
+    the previous retained one — through train_jax's own resume path, not
+    just the checkpoint-module unit test (tests/test_faults.py)."""
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1",
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        num_actors=1,
+        total_env_steps=1_200,
+        replay_min_size=256,
+        replay_capacity=20_000,
+        eval_every=0,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=100,
+        max_learn_ratio=1.0,
+        max_ingest_ratio=1.0,
+        log_path=str(tmp_path / "a.jsonl"),
+    )
+    train_jax(cfg)
+    steps = sorted(
+        int(n.split("_", 1)[1])
+        for n in os.listdir(cfg.checkpoint_dir)
+        if n.startswith("step_")
+    )
+    assert len(steps) >= 2, f"need >=2 retained checkpoints, got {steps}"
+    latest, fallback = steps[-1], steps[-2]
+
+    # Corrupt the latest: truncate its largest payload file.
+    root = os.path.join(cfg.checkpoint_dir, f"step_{latest}")
+    files = []
+    for dirpath, _, names in os.walk(root):
+        files += [os.path.join(dirpath, n) for n in names]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(max(os.path.getsize(target) // 2, 1))
+
+    capfd.readouterr()  # drop the first run's output
+    cfg2 = cfg.replace(
+        total_env_steps=cfg.total_env_steps + 400,
+        log_path=str(tmp_path / "b.jsonl"),
+    )
+    out2 = train_jax(cfg2)
+    captured = capfd.readouterr()
+    assert f"step_{latest} failed verification" in captured.err
+    assert f"resumed from {cfg2.checkpoint_dir} at learner step {fallback}" in (
+        captured.out
+    )
+    assert out2["learner_steps"] >= fallback
+    # The corrupt checkpoint was quarantined (kept for forensics, out of
+    # the step_N namespace) so the resumed run could re-checkpoint at or
+    # past that step without colliding with the corrupt leftovers.
+    assert os.path.isdir(
+        os.path.join(cfg.checkpoint_dir, f"corrupt_step_{latest}")
+    )
+    assert not os.path.isdir(root)
+
+
+def test_sigterm_takes_emergency_checkpoint_and_exits_75(tmp_path):
+    """The preemption contract (docs/RESILIENCE.md): SIGTERM mid-training
+    -> one emergency checkpoint + exit code EXIT_PREEMPTED (75), so a
+    driver can tell 'preempted, resumable' from 'crashed' (and from the
+    watchdog's 70). Runs the real CLI in a subprocess."""
+    ckpt_dir = tmp_path / "ckpt"
+    log_path = tmp_path / "m.jsonl"
+    cmd = [
+        sys.executable, "-m", "distributed_ddpg_tpu.train",
+        "--env_id=Pendulum-v1",
+        "--actor_hidden=16,16", "--critic_hidden=16,16",
+        "--num_actors=1",
+        "--total_env_steps=2000000",       # far beyond the test's lifetime
+        "--replay_min_size=256",
+        "--replay_capacity=20000",
+        "--eval_every=0",
+        f"--checkpoint_dir={ckpt_dir}",
+        "--checkpoint_every=1000000000",   # cadence never fires: any
+                                           # checkpoint is the emergency one
+        f"--log_path={log_path}",
+    ]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        # Wait until the learner is demonstrably training (first train
+        # record) so the SIGTERM lands mid-run, then preempt.
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            if log_path.exists() and '"kind": "train"' in log_path.read_text():
+                break
+            time.sleep(0.5)
+        assert proc.poll() is None, (
+            f"trainer died before SIGTERM: {proc.communicate()[1][-2000:]}"
+        )
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == EXIT_PREEMPTED, (
+        f"exit {proc.returncode} != {EXIT_PREEMPTED};\nstderr: {err[-3000:]}"
+    )
+    assert "emergency checkpoint" in err
+    step = ckpt_lib.latest_step(str(ckpt_dir))
+    assert step is not None, "no emergency checkpoint was written"
+    ok, why = ckpt_lib.verify_checkpoint(str(ckpt_dir), step)
+    assert ok, why
+    final = [r for r in _records(log_path) if r["kind"] == "final"]
+    assert final and final[-1]["emergency_ckpt"] == 1

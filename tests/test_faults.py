@@ -1,0 +1,512 @@
+"""Fault-injection layer unit tests (faults.py; docs/RESILIENCE.md): the
+--faults grammar, seeded determinism, FaultSite call-ordinal semantics,
+checkpoint write-retry + manifest verification + restore fallback, the
+pool monitor's backoff/quarantine/zero-rows machinery (with a stubbed
+spawn — no real worker processes), shipper restart, and the
+ChunkPrefetcher hang paths the PR-1 hardening never had tests for."""
+
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from distributed_ddpg_tpu import checkpoint as ckpt_lib
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.faults import FaultPlan, FaultSpec, InjectedFault
+
+# ---------------------------------------------------------------------------
+# grammar / plan semantics
+# ---------------------------------------------------------------------------
+
+
+def test_parse_full_grammar():
+    p = FaultPlan.parse(
+        "worker:2:crash@5000; worker:0:hang@8000;ckpt:write:ioerror@2;"
+        "shipper:slow@3~0.01;prefetch:sample:hang@1~0.5"
+    )
+    assert len(p.specs) == 5
+    by_kind = {s.kind: s for s in p.specs}
+    assert by_kind["crash"].component == "worker"
+    assert by_kind["crash"].target == "2"
+    assert by_kind["crash"].at == 5000
+    assert by_kind["ioerror"].target == "write"
+    assert by_kind["slow"].duration_s == 0.01  # explicit ~ wins
+    assert by_kind["hang"].duration_s > 0  # seeded default for site hangs
+
+
+def test_parse_empty_and_legacy():
+    assert not FaultPlan.parse("")
+    assert not FaultPlan.parse(None)
+    legacy = FaultPlan.parse("actor:3:1500")  # old --inject_fault form
+    assert legacy.specs == (FaultSpec("worker", "3", "crash", 1500, 0.0),)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "worker:0:banana@2",      # unknown kind
+        "gpu:0:crash@2",          # unknown component
+        "worker:0:crash",         # missing trigger
+        "worker:0:crash@zero",    # non-integer trigger
+        "worker:0:crash@0",       # trigger < 1
+        "worker:abc:crash@5",     # non-integer worker id
+        "worker:0:ioerror@5",     # site-only kind on a worker
+        "ckpt:write:stall@5",     # worker-only kind on a site
+        "a:b:c:d:crash@5",        # too many fields
+        "worker:0:slow@5~-1",     # negative duration
+    ],
+)
+def test_parse_rejects(bad):
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultPlan.parse(bad)
+
+
+def test_seeded_durations_deterministic():
+    spec = "worker:0:slow@10;shipper:slow@2;prefetch:sample:hang@1"
+    a = FaultPlan.parse(spec, seed=7)
+    b = FaultPlan.parse(spec, seed=7)
+    c = FaultPlan.parse(spec, seed=8)
+    assert [s.duration_s for s in a.specs] == [s.duration_s for s in b.specs]
+    assert [s.duration_s for s in a.specs] != [s.duration_s for s in c.specs]
+
+
+def test_for_worker_incarnation_semantics():
+    p = FaultPlan.parse("worker:1:crash@100;worker:1:crashloop@50;worker:0:hang@10")
+    first = p.for_worker(1, incarnation=0)
+    assert ("crash", 100, 0.0) in first
+    assert ("crash", 50, 0.0) in first  # crashloop arms as a crash
+    respawn = p.for_worker(1, incarnation=3)
+    assert respawn == [("crash", 50, 0.0)]  # ONLY crashloop re-arms
+    assert p.for_worker(2) == []
+
+
+def test_site_ordinal_and_ioerror():
+    site = FaultPlan.parse("ckpt:write:ioerror@3").site("ckpt", "write")
+    site.tick()
+    site.tick()
+    with pytest.raises(InjectedFault):
+        site.tick()
+    site.tick()  # one-shot: the 4th call sails through
+    assert site.calls == 4
+    assert site.fired == ["ckpt:write:ioerror@3"]
+    # InjectedFault must be an OSError: recovery paths written for real IO
+    # failures treat the injected article identically.
+    assert issubclass(InjectedFault, OSError)
+
+
+def test_cli_inject_fault_alias_folds_into_faults():
+    """Pre-chaos-harness scripts pass --inject_fault=actor:<id>:<step>;
+    the flag must keep working as an alias that folds into the plan."""
+    c = DDPGConfig.from_flags(["--inject_fault=actor:0:200"])
+    assert c.fault_plan().for_worker(0) == [("crash", 200, 0.0)]
+    c2 = DDPGConfig.from_flags(
+        ["--faults=worker:1:hang@50", "--inject_fault=actor:0:200"]
+    )
+    assert len(c2.fault_plan().specs) == 2
+
+
+def test_config_validates_fault_grammar():
+    DDPGConfig(faults="worker:0:crash@200")  # valid parses
+    with pytest.raises(ValueError, match="bad fault spec"):
+        DDPGConfig(faults="worker:0:nope@1")
+    with pytest.raises(ValueError, match="heartbeat_timeout_s"):
+        DDPGConfig(heartbeat_timeout_s=0.0)
+    with pytest.raises(ValueError, match="quarantine_respawns"):
+        DDPGConfig(quarantine_respawns=-1)
+    with pytest.raises(ValueError, match="ckpt_write_retries"):
+        DDPGConfig(ckpt_write_retries=-1)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: retry, manifest, fallback chain
+# ---------------------------------------------------------------------------
+
+
+def _tiny_state(cfg, seed=0):
+    from distributed_ddpg_tpu.learner import init_train_state
+
+    return init_train_state(cfg, 3, 1, seed=seed)
+
+
+def test_ckpt_write_retry_consumes_injected_ioerror(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    site = FaultPlan.parse("ckpt:write:ioerror@1").site("ckpt", "write")
+    path = ckpt_lib.save(
+        str(tmp_path), 5, state, None, cfg,
+        retries=2, backoff_s=0.01, fault=site,
+    )
+    assert os.path.isdir(path)
+    ok, why = ckpt_lib.verify_checkpoint(str(tmp_path), 5)
+    assert ok, why
+    # The retry advanced the site ordinal: attempt 1 failed, attempt 2 wrote.
+    assert site.calls == 2
+
+
+def test_ckpt_write_retries_exhausted_raises(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    site = FaultPlan.parse(
+        "ckpt:write:ioerror@1;ckpt:write:ioerror@2;ckpt:write:ioerror@3"
+    ).site("ckpt", "write")
+    with pytest.raises(OSError):
+        ckpt_lib.save(
+            str(tmp_path), 5, state, None, cfg,
+            retries=2, backoff_s=0.01, fault=site,
+        )
+    # No half-written step directory may survive a failed save.
+    assert not os.path.isdir(tmp_path / "step_5")
+
+
+def test_async_saver_counts_write_retries(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    site = FaultPlan.parse("ckpt:write:ioerror@1").site("ckpt", "write")
+    saver = ckpt_lib.AsyncSaver()
+    assert saver.save_async(
+        str(tmp_path), 7, state, None, cfg,
+        retries=2, backoff_s=0.01, fault=site,
+    )
+    saver.wait()
+    assert saver.write_retries == 1
+    assert ckpt_lib.latest_step(str(tmp_path)) == 7
+
+
+def _corrupt_checkpoint(directory, step):
+    """Truncate the largest file under step_<step> — the bit-rot /
+    half-write shape the manifest digest exists to catch."""
+    root = os.path.join(directory, f"step_{step}")
+    files = []
+    for dirpath, _, names in os.walk(root):
+        files += [os.path.join(dirpath, n) for n in names]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(max(os.path.getsize(target) // 2, 1))
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    ckpt_lib.save(str(tmp_path), 10, state, None, cfg, env_steps=111)
+    ckpt_lib.save(str(tmp_path), 20, state, None, cfg, env_steps=222)
+    _corrupt_checkpoint(str(tmp_path), 20)
+    ok, why = ckpt_lib.verify_checkpoint(str(tmp_path), 20)
+    assert not ok and "mismatch" in why
+    # An EXPLICIT step request never falls back — precise asks fail loud.
+    with pytest.raises(Exception):
+        ckpt_lib.restore(str(tmp_path), _tiny_state(cfg, seed=9), step=20)
+    restored, step, env_steps = ckpt_lib.restore(
+        str(tmp_path), _tiny_state(cfg, seed=9), config=cfg
+    )
+    assert step == 10 and env_steps == 111
+    # The corrupt checkpoint is quarantined out of the step_N namespace so
+    # a resumed run re-reaching step 20 can write there again (orbax
+    # refuses existing destinations) — payload kept for forensics.
+    assert not (tmp_path / "step_20").exists()
+    assert (tmp_path / "corrupt_step_20").is_dir()
+    assert not (tmp_path / "manifest_20.json").exists()
+    assert ckpt_lib.latest_step(str(tmp_path)) == 10
+
+
+def test_restore_falls_back_when_load_fails_but_verification_passes(tmp_path):
+    """Corruption the crc spot-check can't see (no manifest + gutted
+    payload, orbax raising ValueError for the tree mismatch) must still
+    fall back — only check_config_compatible's ValueError may abort the
+    chain."""
+    import shutil
+
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    ckpt_lib.save(str(tmp_path), 10, state, None, cfg, env_steps=111)
+    ckpt_lib.save(str(tmp_path), 20, state, None, cfg)
+    os.unlink(tmp_path / "manifest_20.json")   # pre-manifest checkpoint
+    for name in os.listdir(tmp_path / "step_20"):
+        full = tmp_path / "step_20" / name
+        shutil.rmtree(full) if full.is_dir() else os.unlink(full)
+    ok, why = ckpt_lib.verify_checkpoint(str(tmp_path), 20)
+    assert ok and "no manifest" in why  # verification cannot see it
+    restored, step, env_steps = ckpt_lib.restore(
+        str(tmp_path), _tiny_state(cfg, seed=9), config=cfg
+    )
+    assert step == 10 and env_steps == 111
+    # A config incompatibility is a contract violation, not corruption:
+    # it must abort the chain loudly, never silently fall back.
+    bad = cfg.replace(actor_hidden=(16, 16))
+    with pytest.raises(ValueError, match="actor_hidden"):
+        ckpt_lib.restore(str(tmp_path), _tiny_state(bad, seed=9), config=bad)
+
+
+def test_restore_all_corrupt_raises_with_history(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    for step in (1, 2):
+        ckpt_lib.save(str(tmp_path), step, state, None, cfg)
+        _corrupt_checkpoint(str(tmp_path), step)
+    with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+        ckpt_lib.restore(str(tmp_path), _tiny_state(cfg, seed=9))
+
+
+def test_manifest_pruned_with_checkpoint(tmp_path):
+    cfg = DDPGConfig(actor_hidden=(8, 8), critic_hidden=(8, 8))
+    state = _tiny_state(cfg)
+    for step in (10, 20, 30, 40):
+        ckpt_lib.save(str(tmp_path), step, state, None, cfg, keep=2)
+    names = sorted(os.listdir(tmp_path))
+    assert "manifest_30.json" in names and "manifest_40.json" in names
+    assert "manifest_10.json" not in names and "manifest_20.json" not in names
+
+
+# ---------------------------------------------------------------------------
+# pool monitor: backoff, quarantine, zero-rows detector (stubbed spawn)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, alive=True):
+        self._alive = alive
+        self.terminated = False
+
+    def is_alive(self):
+        return self._alive and not self.terminated
+
+    def terminate(self):
+        self.terminated = True
+
+    def join(self, timeout=None):
+        pass
+
+
+def _stub_pool(monkeypatch, **cfg_kw):
+    """An ActorPool whose _spawn never forks: monitor()'s supervision state
+    machine can be driven directly, with _FakeProc / heartbeat pokes
+    standing in for real worker behavior."""
+    from distributed_ddpg_tpu.actors.pool import ActorPool
+    from distributed_ddpg_tpu.envs import make, spec_of
+
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1", actor_hidden=(8, 8), critic_hidden=(8, 8),
+        num_actors=1, transport="queue", **cfg_kw,
+    )
+    env = make(cfg.env_id, seed=0, prefer_builtin=True)
+    pool = ActorPool(cfg, spec_of(env))
+    spawned = []
+
+    def fake_spawn(i):
+        spawned.append(i)
+        pool._incarnation[i] += 1
+        pool._heartbeat[i] = 0.0
+        pool._last_rows_t[i] = 0.0
+        pool._procs[i] = None  # stays dead: every respawn fails again
+
+    monkeypatch.setattr(pool, "_spawn", fake_spawn)
+    return pool, spawned
+
+
+def test_monitor_backoff_then_quarantine(monkeypatch):
+    pool, spawned = _stub_pool(
+        monkeypatch,
+        respawn_backoff_s=0.05, respawn_backoff_max_s=0.2,
+        quarantine_respawns=3, quarantine_window_s=60.0,
+    )
+    # Failure #1 detected; the respawn must NOT happen on the same call
+    # (backoff pending), only after the backoff expires.
+    stats = pool.monitor()
+    assert stats["respawned"] == 0 and pool._pending_respawn[0]
+    time.sleep(0.06)
+    stats = pool.monitor()
+    assert stats["respawned"] == 1 and spawned == [0]
+    # The stub leaves the slot dead, so failures accumulate: #2 respawns
+    # after its (longer) backoff, #3 trips the breaker.
+    time.sleep(0.01)
+    pool.monitor()  # detect failure #2
+    time.sleep(0.25)
+    assert pool.monitor()["respawned"] == 1  # respawn #2
+    stats = pool.monitor()  # detect failure #3 -> quarantine
+    assert stats["quarantined"] == 1
+    assert pool._quarantined[0]
+    assert pool.recovery_counters() == {
+        "actor_respawns": 2, "actor_quarantined": 1,
+    }
+    # Quarantined slots are never touched again.
+    time.sleep(0.25)
+    assert pool.monitor()["respawned"] == 0
+    assert spawned == [0, 0]
+
+
+def test_monitor_zero_rows_blind_spot(monkeypatch):
+    """The watchdog coverage note's actor-side blind spot: a worker that
+    heartbeats but delivers no rows past actor_no_progress_s must be
+    respawned through the same path as a dead one."""
+    pool, spawned = _stub_pool(
+        monkeypatch,
+        actor_no_progress_s=0.1, respawn_backoff_s=0.01,
+        quarantine_respawns=0,  # breaker off: isolate the detector
+    )
+    proc = _FakeProc()
+    pool._procs[0] = proc
+    pool._heartbeat[0] = time.time()  # booted and heartbeating
+    assert pool.monitor()["respawned"] == 0  # arms the zero-rows clock
+    # Fresh heartbeats keep coming, but no rows ever do.
+    time.sleep(0.15)
+    pool._heartbeat[0] = time.time()
+    pool.monitor()  # detects no_rows -> terminates + pending respawn
+    assert proc.terminated
+    time.sleep(0.02)
+    pool.monitor()
+    assert spawned == [0]
+    # Control: rows arriving reset the clock — no respawn.
+    proc2 = _FakeProc()
+    pool._procs[0] = proc2
+    pool._heartbeat[0] = time.time()
+    pool.monitor()
+    time.sleep(0.15)
+    pool._heartbeat[0] = time.time()
+    pool._note_version(0, 0)  # rows drained from this worker
+    pool.monitor()
+    assert not proc2.terminated and spawned == [0]
+
+
+def test_monitor_quarantine_window_prunes_old_failures(monkeypatch):
+    """Failures OUTSIDE quarantine_window_s must not count toward the
+    breaker — only a crash LOOP quarantines, not occasional mortality."""
+    pool, spawned = _stub_pool(
+        monkeypatch,
+        respawn_backoff_s=0.0, quarantine_respawns=3,
+        quarantine_window_s=0.1,
+    )
+    for _ in range(6):  # 6 failures, each in its own expired window
+        pool.monitor()  # detect (backoff 0 -> respawn same/next call)
+        pool.monitor()
+        time.sleep(0.12)
+    assert pool.quarantined_count == 0
+    assert len(spawned) >= 5
+
+
+def test_monitor_fault_site_slows_supervision(monkeypatch):
+    """pool:monitor:slow — the supervisor ITSELF lags; training must only
+    see late detection, never a crash."""
+    pool, _ = _stub_pool(
+        monkeypatch,
+        faults="pool:monitor:slow@1~0.15",
+        quarantine_respawns=0, respawn_backoff_s=0.0,
+    )
+    t0 = time.monotonic()
+    pool.monitor()
+    assert time.monotonic() - t0 >= 0.14
+    t0 = time.monotonic()
+    pool.monitor()  # one-shot: the second pass is full speed
+    assert time.monotonic() - t0 < 0.1
+
+
+# ---------------------------------------------------------------------------
+# ChunkPrefetcher under injected sampler faults (PR-1 hardening, untested)
+# ---------------------------------------------------------------------------
+
+
+class _TinyReplay:
+    def __init__(self):
+        self.rng = np.random.default_rng(0)
+
+    def sample(self, n):
+        return {
+            "obs": self.rng.standard_normal((n, 3)).astype(np.float32),
+            "indices": np.arange(n),
+        }
+
+
+def test_prefetch_timeout_under_injected_sampler_hang():
+    """A hung sampler (prefetch:sample:hang) must surface as the NAMED
+    PrefetchTimeout — worker alive, no chunk — not a bare queue.Empty."""
+    from distributed_ddpg_tpu.parallel.prefetch import (
+        ChunkPrefetcher,
+        PrefetchTimeout,
+    )
+
+    site = FaultPlan.parse("prefetch:sample:hang@1~1.5").site(
+        "prefetch", "sample"
+    )
+    pf = ChunkPrefetcher(
+        _TinyReplay(), lambda c: c, 4, 2, depth=1, fault=site
+    ).start()
+    try:
+        with pytest.raises(PrefetchTimeout, match="worker alive"):
+            pf.next(timeout=0.3)
+        # After the hang lifts, the pipeline self-heals: the chunk arrives.
+        chunk, indices = pf.next(timeout=10.0)
+        assert chunk["obs"].shape == (2, 4, 3)
+    finally:
+        assert pf.stop(timeout=5.0) is True
+
+
+def test_prefetch_stop_during_sampler_hang_leaks_loudly():
+    """stop() during an in-flight sampler hang cannot join in time: it must
+    warn and return False (leak the daemon) rather than hang teardown —
+    and the thread must still exit once the hang lifts."""
+    from distributed_ddpg_tpu.parallel.prefetch import ChunkPrefetcher
+
+    site = FaultPlan.parse("prefetch:sample:hang@1~1.0").site(
+        "prefetch", "sample"
+    )
+    pf = ChunkPrefetcher(
+        _TinyReplay(), lambda c: c, 4, 2, depth=1, fault=site
+    ).start()
+    time.sleep(0.1)  # let the worker enter the hang
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert pf.stop(timeout=0.2) is False
+    assert any("leaking" in str(w.message) for w in caught)
+    pf._thread.join(timeout=5.0)
+    assert not pf._thread.is_alive()
+
+
+def test_prefetch_sampler_crash_surfaces_in_next():
+    from distributed_ddpg_tpu.parallel.prefetch import ChunkPrefetcher
+
+    site = FaultPlan.parse("prefetch:sample:crash@1").site(
+        "prefetch", "sample"
+    )
+    pf = ChunkPrefetcher(
+        _TinyReplay(), lambda c: c, 4, 2, depth=1, fault=site
+    ).start()
+    try:
+        with pytest.raises(RuntimeError, match="prefetch thread died") as ei:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                pf.next(timeout=0.5)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+    finally:
+        pf.stop()
+
+
+# ---------------------------------------------------------------------------
+# ingest shipper: injected crash -> supervised restart
+# ---------------------------------------------------------------------------
+
+
+def test_shipper_restart_after_injected_crash():
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+
+    site = FaultPlan.parse("shipper:ship:crash@1").site("shipper", "ship")
+    rep = DeviceReplay(
+        4096, 3, 1, block_size=64, async_ship=True, fault=site
+    )
+    try:
+        rng = np.random.default_rng(0)
+        block = rng.standard_normal((64, rep.width)).astype(np.float32)
+        rep.add_packed(block)  # shipper's first dispatch crashes
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s = rep._shipper
+            if s is not None and s.exc is not None:
+                break
+            time.sleep(0.02)
+        # Producer path notices, restarts the shipper, and rows flow again.
+        rep.add_packed(block)
+        rep.drain_pending()
+        assert len(rep) >= 64
+        snap = rep.ingest_snapshot()
+        assert snap["ingest_shipper_restarts"] == 1
+    finally:
+        rep.close()

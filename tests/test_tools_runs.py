@@ -75,6 +75,40 @@ def test_summarize_digest_and_render(tmp_path):
     assert runs.summarize_run(str(noisy))["records"]["train"] == 8
 
 
+def test_summarize_recovery_counters(tmp_path, capsys):
+    """Fault history (docs/RESILIENCE.md): the cumulative recovery
+    counters train.py logs must surface in the digest and the rendered
+    summary, so `tools.runs summarize` shows a run's fault history."""
+    path = tmp_path / "run.jsonl"
+    records = _fixture_run(path)
+    for i, r in enumerate(records):
+        if r["kind"] in ("train", "final"):
+            r["actor_respawns"] = min(i, 3)
+            r["actor_quarantined"] = 0
+            r["ckpt_write_retries"] = 1
+            r["emergency_ckpt"] = 0
+    _write_jsonl(path, records)
+    digest = runs.summarize_run(str(path))
+    assert digest["recovery"]["actor_respawns"]["last"] == 3
+    assert digest["recovery"]["ckpt_write_retries"]["last"] == 1
+    rendered = runs.render_summary(digest)
+    assert "recovery / fault history" in rendered
+    assert "actor_respawns" in rendered
+    # A clean run renders the all-zero note instead of a table.
+    clean = tmp_path / "clean.jsonl"
+    recs2 = _fixture_run(clean)
+    for r in recs2:
+        if r["kind"] in ("train", "final"):
+            r.update(actor_respawns=0, actor_quarantined=0,
+                     ckpt_write_retries=0, emergency_ckpt=0)
+    _write_jsonl(clean, recs2)
+    assert "clean run" in runs.render_summary(runs.summarize_run(str(clean)))
+    # compare: recovery counters ride the A/B table, lower-is-better.
+    text, rows = runs.compare_runs(str(clean), str(path))
+    row = [r for r in rows if r[0] == "actor_respawns"]
+    assert row and row[0][2] == 3
+
+
 def test_summarize_cli_smoke(tmp_path, capsys):
     path = tmp_path / "run.jsonl"
     _fixture_run(path)
