@@ -175,17 +175,30 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     learner = ShardedLearner(
         config, OBS_DIM, ACT_DIM, action_scale=1.0, chunk_size=chunk, mesh=mesh
     )
-    # Production ingest pipeline (docs/INGEST.md): coalesced host-ring
-    # staging + background shipper, exactly what train_jax runs.
-    # BENCH_INGEST_ASYNC=0 / BENCH_INGEST_COALESCE=1 recover the seed's
-    # serial inline shipping for A/B measurements.
+    # Production ingest pipeline (docs/INGEST.md + docs/TRANSFER.md):
+    # coalesced host-ring staging + the unified transfer scheduler
+    # (adaptive coalesce, pooled staging buffers), exactly what train_jax
+    # runs. BENCH_INGEST_ASYNC=0 / BENCH_INGEST_COALESCE=1 /
+    # BENCH_TRANSFER_SCHED=0 recover the seed's serial inline shipping
+    # (or the PR-1 private-shipper pipeline) for A/B measurements.
+    sched = None
+    if os.environ.get("BENCH_TRANSFER_SCHED", "1") == "1":
+        from distributed_ddpg_tpu.transfer import TransferScheduler
+
+        sched = TransferScheduler().start()
     device_replay = DeviceReplay(
         config.replay_capacity, OBS_DIM, ACT_DIM, mesh=learner.mesh,
         block_size=4096,
         async_ship=os.environ.get("BENCH_INGEST_ASYNC", "1") == "1",
         max_coalesce=int(os.environ.get("BENCH_INGEST_COALESCE",
                                         str(config.ingest_coalesce))),
+        scheduler=sched,
+        adaptive_coalesce=(
+            sched is not None and config.ingest_coalesce_adaptive
+        ),
+        host_pool=sched is not None and config.transfer_host_pool,
     )
+    learner.transfer = sched
     # Initial fill mirroring the host replay contents (warm buffer).
     idx = np.arange(len(replay))
     device_replay.add_packed(pack_batch_np(replay.gather(idx)))
@@ -227,8 +240,15 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
     elapsed = time.perf_counter() - t0
     rate = steps / elapsed
     ingest = device_replay.ingest_snapshot()
+    transfer_fields = {}
+    if sched is not None:
+        transfer_fields = {
+            **sched.snapshot(), **device_replay.transfer_snapshot(),
+        }
     phase_fields = phases.snapshot()
     device_replay.close()
+    if sched is not None:
+        sched.close()
 
     dev = jax.devices()[0]
     n_dev = learner.mesh.size
@@ -255,6 +275,9 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
         # device call, producer stall on backpressure, queue depth.
         **phase_fields,
         **ingest,
+        # Transfer-scheduler breakdown (docs/TRANSFER.md): per-class
+        # dispatches/bytes/tails + the adaptive-coalesce trajectory.
+        **transfer_fields,
     }
     peak = _peak_flops(dev.device_kind)
     if peak is not None:
@@ -358,7 +381,13 @@ def phase_ingest() -> dict:
                 "ingest_coalesce_mean", "ingest_stall_ms",
                 "ingest_ship_ms", "ingest_queue_rows",
             )
-        }
+            if k in r
+        },
+        # Transfer-scheduler smoke fields (docs/TRANSFER.md): present and
+        # self-consistent whenever the scheduler ran (the default).
+        "transfer_bench": {
+            k: v for k, v in r.items() if k.startswith("transfer_")
+        },
     }
 
 
@@ -406,6 +435,11 @@ def phase_scaling() -> dict:
                 "ingest_rows_per_sec": r["ingest_rows_per_sec"],
                 "ingest_coalesce_mean": r["ingest_coalesce_mean"],
                 "ingest_stall_ms": r["ingest_stall_ms"],
+                # Transfer-scheduler tails ride the curve so a per-mesh
+                # scheduler regression shows up where the BENCH_r05
+                # ingest regression once hid.
+                "transfer_ingest_p95": r.get("transfer_ingest_p95", 0.0),
+                "transfer_coalesce_cap": r.get("transfer_coalesce_cap", 0),
             }
         curves[label] = curve
     return {"scaling_cpu_virtual": curves}
@@ -744,7 +778,7 @@ def main() -> int:
             # Phase breakdown (means + p50/p95/max tails), call counts,
             # and the full ingest_* family ride to the top-level record.
             if key.startswith(("t_dispatch", "t_ingest", "n_dispatch",
-                               "n_ingest", "ingest_")) or key in (
+                               "n_ingest", "ingest_", "transfer_")) or key in (
                 "chunk", "fused_chunk_error", "fused_chunk_active",
             ):
                 result[key] = accel[key]

@@ -17,7 +17,12 @@ flow learner->workers through shared memory instead of gRPC pulls).
   crash-looping slot (config.quarantine_respawns failures within
   config.quarantine_window_s) is QUARANTINED: the pool logs loudly, stops
   respawning it, and training continues degraded — a respawn stampede of
-  doomed workers is strictly worse than one missing actor.
+  doomed workers is strictly worse than one missing actor. After
+  config.quarantine_probe_s the slot is PROBED with a single respawn
+  attempt: sustained progress (rows delivered + surviving
+  quarantine_window_s) un-quarantines it (counter actor_unquarantined),
+  a probe failure re-quarantines for another cooldown — a half-capacity
+  fleet recovers from transient faults without a run restart.
 - Fault injection (config.faults; faults.py): each worker receives its
   slice of the run's FaultPlan at spawn time. One-shot faults arm only the
   slot's FIRST incarnation (recovery must be observable); `crashloop`
@@ -132,11 +137,26 @@ class ActorPool:
         self._backoff_until = [0.0] * self.num_actors
         self._pending_respawn = [False] * self.num_actors
         self._quarantined = [False] * self.num_actors
+        # Quarantine probing (config.quarantine_probe_s): after a
+        # cooldown, a quarantined slot gets ONE respawn attempt; sustained
+        # progress un-quarantines it, any failure during the probe
+        # re-quarantines immediately. A half-capacity fleet whose fault
+        # was transient recovers without a run restart.
+        self._quarantined_at = [0.0] * self.num_actors
+        self._probing = [False] * self.num_actors
+        self._probe_t = [0.0] * self.num_actors
+        self._unquarantines = 0
         # Zero-rows detector clock: 0.0 = "no rows seen this incarnation";
         # armed lazily at the first observed heartbeat (boot can take many
         # seconds under cold-start contention, and the detector must not
         # count boot time as silence).
         self._last_rows_t = [0.0] * self.num_actors
+        # Actual-rows clock: written ONLY when experience is drained from
+        # the worker (_note_version) — unlike _last_rows_t, which the
+        # zero-rows detector also ARMS at first heartbeat. The probe's
+        # sustained-progress check reads this one, so a heartbeating-but-
+        # rowless probe can never be mistaken for a recovery.
+        self._rows_seen_t = [0.0] * self.num_actors
         # Env-step progress restored from a checkpoint (set by the driver
         # BEFORE start()): counts against the uniform-warmup budget so a
         # resumed run doesn't re-inject warmup_uniform random actions.
@@ -227,6 +247,7 @@ class ActorPool:
         # that caused the timeout).
         self._heartbeat[worker_id] = 0.0
         self._last_rows_t[worker_id] = 0.0  # re-armed at first heartbeat
+        self._rows_seen_t[worker_id] = 0.0
         self._procs[worker_id] = p
 
     def start(self, actor_params) -> "ActorPool":
@@ -271,8 +292,10 @@ class ActorPool:
     def _note_version(self, worker_id: int, version: int) -> None:
         acted_at = self._version_steps.get(version, 0)
         self._staleness[worker_id] = self._last_broadcast_step - acted_at
-        # Rows arrived from this worker: feed the zero-rows detector.
+        # Rows arrived from this worker: feed the zero-rows detector and
+        # the probe's sustained-progress clock.
         self._last_rows_t[worker_id] = time.time()
+        self._rows_seen_t[worker_id] = self._last_rows_t[worker_id]
 
     def staleness(self) -> Dict[str, float]:
         """Learner-step staleness of the params behind each worker's most
@@ -398,7 +421,48 @@ class ActorPool:
         respawned = 0
         for i, p in enumerate(self._procs):
             if self._quarantined[i]:
+                # Quarantine probing: after the cooldown, one respawn
+                # attempt. The slot leaves quarantine provisionally
+                # (_probing) so the normal detectors cover it — but any
+                # failure during the probe re-quarantines immediately
+                # instead of re-entering the backoff/breaker cycle.
+                if (
+                    cfg.quarantine_probe_s > 0
+                    and now - self._quarantined_at[i] >= cfg.quarantine_probe_s
+                ):
+                    self._quarantined[i] = False
+                    self._probing[i] = True
+                    self._probe_t[i] = now
+                    self._fail_times[i] = []
+                    self._respawns += 1
+                    respawned += 1
+                    trace.instant("actor_probe", worker=i)
+                    print(
+                        f"[pool] probing quarantined worker {i} after "
+                        f"{cfg.quarantine_probe_s:.0f}s cooldown (single "
+                        "respawn attempt)",
+                        file=sys.stderr, flush=True,
+                    )
+                    self._spawn(i)
                 continue
+            if self._probing[i] and not self._pending_respawn[i]:
+                # Probe success = sustained progress: rows delivered since
+                # the probe spawn AND a full quarantine_window_s survived.
+                if (
+                    self._rows_seen_t[i] > self._probe_t[i]
+                    and now - self._probe_t[i] >= cfg.quarantine_window_s
+                ):
+                    self._probing[i] = False
+                    self._unquarantines += 1
+                    trace.instant("actor_unquarantined", worker=i)
+                    print(
+                        f"[pool] worker {i} UN-QUARANTINED: sustained "
+                        f"progress for {cfg.quarantine_window_s:.0f}s "
+                        "after probe — fleet back to "
+                        f"{self.num_actors - self.quarantined_count} "
+                        "workers",
+                        file=sys.stderr, flush=True,
+                    )
             if not self._pending_respawn[i]:
                 why = self._detect_failure(i, p, now)
                 if why is None:
@@ -407,6 +471,19 @@ class ActorPool:
                     p.terminate()
                     p.join(timeout=2.0)
                 self._procs[i] = None
+                if self._probing[i]:
+                    # The single probe attempt failed: straight back to
+                    # quarantine for another cooldown — no backoff loop.
+                    self._probing[i] = False
+                    self._quarantined[i] = True
+                    self._quarantined_at[i] = now
+                    trace.instant("actor_probe_failed", worker=i, why=why)
+                    print(
+                        f"[pool] probe of worker {i} failed ({why}); "
+                        "re-quarantined",
+                        file=sys.stderr, flush=True,
+                    )
+                    continue
                 window = [
                     t for t in self._fail_times[i]
                     if now - t <= cfg.quarantine_window_s
@@ -418,6 +495,7 @@ class ActorPool:
                     and len(window) >= cfg.quarantine_respawns
                 ):
                     self._quarantined[i] = True
+                    self._quarantined_at[i] = now
                     trace.instant("actor_quarantined", worker=i, why=why,
                                   failures=len(window))
                     print(
@@ -426,7 +504,12 @@ class ActorPool:
                         f"{cfg.quarantine_window_s:.0f}s — respawns "
                         "suspended, training continues degraded on "
                         f"{self.num_actors - self.quarantined_count} "
-                        "workers",
+                        "workers"
+                        + (
+                            f"; probe in {cfg.quarantine_probe_s:.0f}s"
+                            if cfg.quarantine_probe_s > 0
+                            else ""
+                        ),
                         file=sys.stderr, flush=True,
                     )
                     continue
@@ -481,6 +564,7 @@ class ActorPool:
         return {
             "actor_respawns": self._respawns,
             "actor_quarantined": self.quarantined_count,
+            "actor_unquarantined": self._unquarantines,
         }
 
     @property

@@ -121,6 +121,37 @@ class DDPGConfig:
     # (power-of-two groups; 1 = the seed's serial block-at-a-time ships).
     ingest_async: bool = True
     ingest_coalesce: int = 8
+    # --- unified transfer scheduler (transfer/; docs/TRANSFER.md) ---
+    # One dispatch thread owns every host<->device stream — replay-ingest
+    # super-blocks, prefetch chunk h2d, learner d2h accounting, and the
+    # multi-host lockstep ingest collective — with prioritized work
+    # classes fair-queued by bytes so prefetch never starves under an
+    # ingest flood (and vice versa). Forced off under strict_sync: the
+    # scheduler thread's dispatch timing would make the metrics stream a
+    # function of host scheduling.
+    transfer_scheduler: bool = True
+    # Adaptive ingest_coalesce controller (transfer/adaptive.py): the
+    # EFFECTIVE coalesce cap grows (x2, up to ingest_coalesce) while the
+    # staging queue trends up and shrinks on dispatch stall. Replay
+    # contents are bit-identical to the serial path for ANY cap sequence;
+    # strict_sync disables it anyway because the cap trajectory (hence
+    # the ingest_coalesce_mean metric) is wall-clock-driven. Single-
+    # process shipping only — the lockstep collective keeps the static
+    # cap so every process computes the identical k sequence.
+    ingest_coalesce_adaptive: bool = True
+    # Staged host-buffer pool for super-block device_put
+    # (transfer/hostbuf.py): recycles the per-ship staging copy through
+    # long-lived buffers fenced on the consuming insert, cutting the
+    # pageable alloc+copy churn out of ingest_ship_ms.
+    transfer_host_pool: bool = True
+    # Multi-host: run the lockstep sync_ship collective as BACKGROUND
+    # beats on the scheduler's ordered lane (replay/device.py
+    # sync_ship_begin) instead of blocking the learner thread at every
+    # chunk boundary. Lockstep semantics are preserved by the token
+    # protocol (docs/TRANSFER.md): pending counts snapshot at beat-issue
+    # time, strict FIFO lane, and the learner gates its next dispatch on
+    # the previous beat's enqueue. No effect single-process.
+    sync_ship_background: bool = True
 
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15
@@ -285,6 +316,15 @@ class DDPGConfig:
     # strictly worse than one missing actor). 0 = breaker off.
     quarantine_respawns: int = 5
     quarantine_window_s: float = 60.0
+    # Quarantine probing (docs/RESILIENCE.md): after this cooldown the
+    # monitor PROBES a quarantined slot with a single respawn attempt —
+    # sustained progress (rows delivered + surviving quarantine_window_s)
+    # un-quarantines it (counter actor_unquarantined), any failure during
+    # the probe re-quarantines immediately for another cooldown. A
+    # half-capacity fleet whose fault was transient (OOM storm, env-server
+    # restart) recovers without a run restart. 0 = never probe (the
+    # pre-PR-5 behavior: quarantine is permanent for the run's lifetime).
+    quarantine_probe_s: float = 300.0
     # Checkpoint write retry (checkpoint.py): transient IO failures retry
     # up to this many times with exponential backoff before surfacing.
     ckpt_write_retries: int = 2
@@ -524,6 +564,8 @@ class DDPGConfig:
             raise ValueError("quarantine_respawns must be >= 0 (0 = off)")
         if self.quarantine_window_s <= 0:
             raise ValueError("quarantine_window_s must be > 0")
+        if self.quarantine_probe_s < 0:
+            raise ValueError("quarantine_probe_s must be >= 0 (0 = off)")
         if self.ckpt_write_retries < 0:
             raise ValueError("ckpt_write_retries must be >= 0")
         if self.ckpt_retry_backoff_s < 0:

@@ -42,6 +42,10 @@ Fault semantics by component:
     prefetch:sample:hang@K~S K-th prefetch sample sleeps S (PrefetchTimeout
                              territory when S exceeds next()'s deadline)
     pool:broadcast:slow@K~S  K-th param broadcast sleeps S first
+    transfer:dispatch:crash@K K-th transfer-scheduler dispatch raises,
+                             killing the scheduler THREAD (its bounded
+                             self-restart path — transfer/scheduler.py)
+    transfer:dispatch:slow@K~S K-th transfer dispatch sleeps S first
 
 The legacy one-shot hook `--inject_fault=actor:<id>:<step>` is accepted as
 an alias for `worker:<id>:crash@<step>`.
@@ -61,7 +65,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt")
+COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer")
 KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror")
 
 # Worker `slow` faults throttle this many consecutive env steps, then lift

@@ -274,6 +274,91 @@ class IngestStats:
         return out
 
 
+class TransferStats:
+    """Thread-safe counters for the unified transfer scheduler
+    (transfer/scheduler.py; docs/TRANSFER.md) — the scheduler-level
+    complement to IngestStats' pipeline view. Per work class (lockstep /
+    ingest / prefetch / d2h) it tracks items dispatched, bytes moved, and
+    dispatch wall time with a deterministic reservoir for tails; queue
+    depths ride in at snapshot time as gauges. snapshot() emits the
+    `transfer_*` fields each train/bench record carries and resets the
+    interval (restart count and queue depths are cumulative/gauge):
+
+      transfer_dispatches        scheduled items dispatched this interval
+      transfer_<cls>_items       per-class dispatches
+      transfer_<cls>_bytes       per-class bytes moved
+      transfer_<cls>_ms          mean dispatch wall time per item
+      transfer_<cls>_p95         reservoir p95 dispatch time (ms)
+      transfer_queue_<cls>       current queue depth (gauge)
+      transfer_queue_<cls>_max   max depth seen this interval (the
+                                 instantaneous gauge is ~0 at the log
+                                 cadence — the scheduler drains between
+                                 records; the max is the backlog signal)
+      transfer_restarts          cumulative scheduler-thread restarts
+    """
+
+    # d2h runs inline on the caller thread (scheduler.run_inline) but is
+    # accounted identically; it is excluded from transfer_dispatches,
+    # which counts the SCHEDULED classes the dispatch thread executed.
+    SCHEDULED = ("lockstep", "ingest", "prefetch")
+    CLASSES = SCHEDULED + ("d2h",)
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self._items = {c: 0 for c in self.CLASSES}
+        self._bytes = {c: 0 for c in self.CLASSES}
+        self._time_s = {c: 0.0 for c in self.CLASSES}
+        self._res = {
+            c: _Reservoir(64, (zlib.crc32(c.encode()) ^ self._seed) & 0x7FFFFFFF)
+            for c in self.CLASSES
+        }
+        self._depth_max = {c: 0 for c in self.SCHEDULED}
+
+    def record_dispatch(self, cls: str, nbytes: int, dur_s: float) -> None:
+        with self._lock:
+            if cls not in self._items:
+                return
+            self._items[cls] += 1
+            self._bytes[cls] += int(nbytes)
+            self._time_s[cls] += dur_s
+            self._res[cls].add(dur_s)
+
+    def record_queue_depth(self, cls: str, depth: int) -> None:
+        with self._lock:
+            if cls in self._depth_max and depth > self._depth_max[cls]:
+                self._depth_max[cls] = depth
+
+    def snapshot(self, queue_depths=None, restarts: int = 0, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {
+                "transfer_dispatches": sum(
+                    self._items[c] for c in self.SCHEDULED
+                ),
+                "transfer_restarts": int(restarts),
+            }
+            for c in self.CLASSES:
+                n = self._items[c]
+                out[f"transfer_{c}_items"] = n
+                out[f"transfer_{c}_bytes"] = self._bytes[c]
+                out[f"transfer_{c}_ms"] = (
+                    round(1000.0 * self._time_s[c] / n, 3) if n else 0.0
+                )
+                out[f"transfer_{c}_p95"] = round(
+                    1000.0 * self._res[c].percentile(0.95), 3
+                )
+            for c, d in (queue_depths or {}).items():
+                out[f"transfer_queue_{c}"] = int(d)
+            for c, d in self._depth_max.items():
+                out[f"transfer_queue_{c}_max"] = int(d)
+            if reset:
+                self._reset_locked()
+        return out
+
+
 class Timer:
     """Running steps/sec meter for the actor/learner rate metrics.
     Monotonic clock: a wall-clock jump (NTP step, manual date set) on a

@@ -141,6 +141,11 @@ class ShardedLearner:
         self.state: TrainState = jax.device_put(state, self._state_sharding)
         self._action_scale = action_scale
         self._action_offset = action_offset
+        # Unified transfer scheduler (docs/TRANSFER.md): when train_jax
+        # attaches one, the learner's d2h pulls run through its inline
+        # d2h class — absolute priority (no queueing on the hot path) but
+        # full bytes/latency accounting in the transfer_* family.
+        self.transfer = None
         self._build_programs()
         self._key = jax.device_put(
             jax.random.PRNGKey(config.seed),
@@ -706,13 +711,30 @@ class ShardedLearner:
         tunneled TPU it is the single most expensive host-visible call —
         the timeline shows it as the learner-thread gap before every
         param refresh / eval snapshot."""
-        with trace.span("params_d2h"):
-            return jax.tree.map(
-                np.asarray, jax.device_get(self.state.actor_params)
-            )
+        def fetch():
+            with trace.span("params_d2h"):
+                return jax.tree.map(
+                    np.asarray, jax.device_get(self.state.actor_params)
+                )
+
+        if self.transfer is None:
+            return fetch()
+        return self.transfer.run_inline(
+            "d2h", fetch, label="params_d2h",
+            nbytes_of=lambda r: sum(l.nbytes for l in jax.tree.leaves(r)),
+        )
 
     def metrics_to_host(self, out: StepOutput) -> Dict[str, float]:
-        with trace.span("metrics_d2h"):
-            return {
-                k: float(v) for k, v in jax.device_get(out.metrics).items()
-            }
+        def fetch():
+            with trace.span("metrics_d2h"):
+                return {
+                    k: float(v)
+                    for k, v in jax.device_get(out.metrics).items()
+                }
+
+        if self.transfer is None:
+            return fetch()
+        return self.transfer.run_inline(
+            "d2h", fetch, label="metrics_d2h",
+            nbytes_of=lambda r: 8 * len(r),
+        )

@@ -68,6 +68,22 @@ def initialize(
         raise
 
 
+def allgather_scalar(value, dtype=None):
+    """All-gather one host scalar across processes; returns a numpy array
+    of shape [process_count]. The ONE host-initiated DCN collective the
+    ingest/budget machinery needs (replay/device.py sync_ship beats,
+    train.py's global env-step budget). Centralized here so every caller
+    — including the transfer scheduler's lockstep lane, which must be the
+    only thread issuing host-initiated collectives when background
+    sync_ship is active (docs/TRANSFER.md) — goes through one audited
+    entry point."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(value, dtype) if dtype is not None else np.asarray(value)
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def process_info() -> dict:
     import jax
 

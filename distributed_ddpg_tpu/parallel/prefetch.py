@@ -41,9 +41,17 @@ class ChunkPrefetcher:
         depth: int = 2,
         lock: Optional[threading.Lock] = None,
         fault=None,                 # faults.FaultSite ticked per sample
+        scheduler=None,             # transfer.TransferScheduler (optional)
     ):
         self._replay = replay
         self._put = put_chunk
+        # Unified transfer scheduler (docs/TRANSFER.md): when attached,
+        # the h2d device_put is submitted as a 'prefetch'-class work item
+        # instead of running inline — the scheduler's fair queue then
+        # rate-balances it against replay-ingest super-blocks (neither
+        # stream can starve the other). Sampling stays on this worker
+        # thread: it is CPU work, not bus work.
+        self._sched = scheduler
         self._batch_size = batch_size
         self._chunk = chunk_size
         self._lock = lock or threading.Lock()
@@ -88,8 +96,29 @@ class ChunkPrefetcher:
                 # strand the join behind a transfer nobody will consume.
                 if self._stop.is_set():
                     return
-                with trace.span("prefetch_h2d"):
-                    device_chunk = self._put(chunk)
+                if self._sched is not None:
+                    nbytes = sum(
+                        getattr(v, "nbytes", 0) for v in chunk.values()
+                    )
+                    ticket = self._sched.submit(
+                        "prefetch", lambda: self._put(chunk),
+                        nbytes=nbytes, label="prefetch_h2d",
+                    )
+                    # Bounded waits so a stop() during a scheduler stall
+                    # still joins; a dead scheduler surfaces through the
+                    # ticket as TransferError -> next()'s 'prefetch
+                    # thread died'.
+                    while not ticket.done():
+                        if self._stop.is_set():
+                            ticket.wait(5.0)
+                            break
+                        ticket.wait(0.1)
+                    if not ticket.done():
+                        return
+                    device_chunk = ticket.result(timeout=0.0)
+                else:
+                    with trace.span("prefetch_h2d"):
+                        device_chunk = self._put(chunk)
                 # Block here (not in get()) when the queue is full — this is
                 # the backpressure that makes `depth` the buffer bound.
                 while not self._stop.is_set():

@@ -59,6 +59,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.metrics import IngestStats
 from distributed_ddpg_tpu.replay.staging import HostStagingRing
+from distributed_ddpg_tpu.transfer import AdaptiveCoalesce, HostBufferPool
 from distributed_ddpg_tpu.types import packed_width
 
 
@@ -126,6 +127,10 @@ class DeviceReplay:
         max_coalesce: int = 8,
         staging_blocks: int = 16,
         fault=None,
+        scheduler=None,
+        adaptive_coalesce: bool = False,
+        host_pool: bool = False,
+        background_sync: bool = False,
     ):
         self.capacity = int(capacity)
         self.obs_dim = obs_dim
@@ -213,10 +218,37 @@ class DeviceReplay:
             )
             self._insert_global_cache = {}
 
+        # --- unified transfer scheduler integration (docs/TRANSFER.md) ---
+        # When a TransferScheduler is attached, single-process async
+        # shipping submits ingest work items to it instead of running the
+        # private _IngestShipper thread, the coalesce cap can adapt, a
+        # host-buffer pool recycles the super-block staging copies, and
+        # multi-host sync_ship beats can run on the scheduler's lockstep
+        # lane in the background.
+        self._sched = scheduler
+        self._adaptive = (
+            AdaptiveCoalesce(hi=self._max_coalesce, block_size=self.block_size)
+            if adaptive_coalesce and self._max_coalesce > 1
+            else None
+        )
+        self._pool = HostBufferPool(self.width) if host_pool else None
+        self._ingest_inflight = False
+        self._ingest_ticket = None
+        self._ingest_exc: Optional[BaseException] = None
+        self._bg_sync = (
+            bool(background_sync) and scheduler is not None and self._procs > 1
+        )
+        self._beat = 0
+
         # Background shipper (single-process only: multi-host rows may
         # leave the host ONLY via the lockstep sync_ship collective).
         self._async = bool(async_ship) and self._procs == 1
-        self._shipper = _IngestShipper(self).start() if self._async else None
+        self._sched_ingest = self._async and self._sched is not None
+        self._shipper = (
+            _IngestShipper(self).start()
+            if self._async and not self._sched_ingest
+            else None
+        )
 
     def __len__(self) -> int:
         return int(jax.device_get(self.size))
@@ -278,13 +310,27 @@ class DeviceReplay:
         out["ingest_shipper_restarts"] = self._shipper_restarts
         return out
 
+    def transfer_snapshot(self) -> dict:
+        """Replay-owned transfer_* fields: the adaptive-coalesce
+        trajectory and host-pool gauges (the scheduler's own counters ride
+        TransferScheduler.snapshot; train.py merges both)."""
+        out = {}
+        if self._adaptive is not None:
+            out.update(self._adaptive.snapshot())
+        if self._pool is not None:
+            out.update(self._pool.snapshot())
+        return out
+
     def close(self) -> None:
-        """Stop the background shipper (if any); subsequent add_packed
-        calls fall back to inline shipping, so teardown stragglers still
-        land."""
+        """Stop the background shipper (if any) and detach from the
+        transfer scheduler; subsequent add_packed calls fall back to
+        inline shipping, so teardown stragglers still land."""
         if self._shipper is not None:
             self._shipper.stop()
             self._shipper = None
+            self._async = False
+        if self._sched_ingest:
+            self._sched_ingest = False
             self._async = False
 
     # --- host -> HBM ingestion ---
@@ -313,42 +359,163 @@ class DeviceReplay:
                 self._shipper = _IngestShipper(self).start()
                 return
             raise IngestError("ingest shipper thread died") from s.exc
+        # Scheduler-path equivalent: a failed ingest work item (its own
+        # exception, or a scheduler-thread death that failed the ticket
+        # before the item ran) recovers through the same bounded-restart
+        # budget — resubmit up to the cap, then IngestError.
+        t = self._ingest_ticket
+        if t is not None and t.done() and t.exception is not None:
+            with self._staging:
+                self._ingest_inflight = False
+            self._ingest_exc = self._ingest_exc or t.exception
+            self._ingest_ticket = None
+        exc = self._ingest_exc
+        if exc is not None:
+            self._ingest_exc = None
+            if self._shipper_restarts < self._max_shipper_restarts:
+                self._shipper_restarts += 1
+                trace.instant("shipper_restart", n=self._shipper_restarts)
+                import sys
 
-    def _coalesce_k(self, n_blocks: int, cap_blocks: int) -> int:
+                print(
+                    f"[ingest] transfer ingest work died ({exc!r}); "
+                    f"resubmitting ({self._shipper_restarts}/"
+                    f"{self._max_shipper_restarts})",
+                    file=sys.stderr, flush=True,
+                )
+                if self._sched_ingest:
+                    with self._staging:
+                        self._submit_ingest_locked()
+                return
+            raise IngestError("ingest shipper thread died") from exc
+
+    def _coalesce_k(self, n_blocks: int, cap_blocks: int, cap: Optional[int] = None) -> int:
         """Blocks to fold into the next super-block ship: largest power of
-        two <= min(staged, max_coalesce, capacity) — capacity-capped so
+        two <= min(staged, coalesce cap, capacity) — capacity-capped so
         every scatter index within one super-block is distinct, which is
-        what makes the coalesced scatter equal the serial sequence."""
-        k = min(n_blocks, self._max_coalesce, max(1, cap_blocks))
+        what makes the coalesced scatter equal the serial sequence. The
+        cap defaults to the static config value; single-process shipping
+        paths pass the adaptive controller's effective cap (any cap
+        sequence lands rows at identical positions, so adaptivity cannot
+        perturb replay contents)."""
+        k = min(n_blocks, cap or self._max_coalesce, max(1, cap_blocks))
         if k <= 0:
             return 0
         return 1 << (k.bit_length() - 1)
 
+    def _effective_coalesce(self) -> int:
+        return (
+            self._adaptive.cap()
+            if self._adaptive is not None
+            else self._max_coalesce
+        )
+
+    def _drain_step(self) -> int:
+        """Ship ONE coalesced super-block if at least one full block is
+        staged; returns rows shipped. All pops happen under the dispatch
+        lock so the pop -> device-op order is the ring's FIFO order no
+        matter which thread ships (inline, _IngestShipper, or the transfer
+        scheduler)."""
+        cap_blocks = self.capacity // self.block_size
+        with self.dispatch_lock:
+            with self._staging:
+                k = self._coalesce_k(
+                    len(self._ring) // self.block_size, cap_blocks,
+                    cap=self._effective_coalesce(),
+                )
+            if k == 0:
+                return 0
+            n = k * self.block_size
+            # Pooled staging copy (transfer/hostbuf.py): acquire OUTSIDE
+            # the staging condition (it may fence-wait on the device), pop
+            # into it under the condition. The ring can only grow between
+            # the two (every popper holds dispatch_lock), so k stays valid.
+            buf = self._pool.acquire(n) if self._pool is not None else None
+            with self._staging:
+                rows = (
+                    self._ring.pop_into(n, buf)
+                    if buf is not None
+                    else self._ring.pop(n)
+                )
+                self._staging.notify_all()
+            t0 = time.perf_counter()
+            try:
+                with trace.span("ingest_ship", rows=n, blocks=k):
+                    self._ship(rows)
+            except BaseException:
+                if buf is not None:
+                    # The ship never consumed the buffer into storage (or
+                    # the orphaned device_put copy will never be read):
+                    # return it unfenced so the bounded-restart resubmit
+                    # does not find the pool drained.
+                    self._pool.commit(buf, None)
+                raise
+            dt = time.perf_counter() - t0
+            self._stats.record_ship(n, k, dt)
+            if buf is not None:
+                # Fence on the insert's OUTPUT: the buffer recirculates
+                # only after the op that read the transferred chunk has
+                # executed (hostbuf.py module docstring).
+                self._pool.commit(buf, self.size)
+            if self._adaptive is not None:
+                with self._staging:
+                    queue_rows = len(self._ring)
+                self._adaptive.observe_ship(k, dt, queue_rows)
+        return n
+
     def _drain_ring(self) -> int:
         """Ship every currently-staged FULL block, coalesced. Called
         inline (sync mode), from the shipper thread (async mode), and from
-        flush/sync_ship/drain_pending — all pops happen under the dispatch
-        lock so the pop -> device-op order is the ring's FIFO order no
-        matter which thread ships."""
+        flush/sync_ship/drain_pending."""
         shipped = 0
-        cap_blocks = self.capacity // self.block_size
         while True:
-            with self.dispatch_lock:
-                with self._staging:
-                    k = self._coalesce_k(
-                        len(self._ring) // self.block_size, cap_blocks
-                    )
-                    if k == 0:
-                        return shipped
-                    rows = self._ring.pop(k * self.block_size)
-                    self._staging.notify_all()
-                t0 = time.perf_counter()
-                with trace.span("ingest_ship", rows=len(rows), blocks=k):
-                    self._ship(rows)
-                self._stats.record_ship(
-                    len(rows), k, time.perf_counter() - t0
-                )
-            shipped += k * self.block_size
+            n = self._drain_step()
+            if n == 0:
+                return shipped
+            shipped += n
+
+    # --- transfer-scheduler ingest work items (docs/TRANSFER.md) ---
+
+    def _submit_ingest_locked(self) -> None:
+        """Queue one ingest work item on the transfer scheduler if a full
+        block is staged and none is in flight. Caller holds _staging."""
+        if (
+            not self._sched_ingest
+            or self._ingest_inflight
+            or len(self._ring) < self.block_size
+        ):
+            return
+        self._ingest_inflight = True
+        try:
+            self._ingest_ticket = self._sched.submit(
+                "ingest", self._scheduled_drain_step, label="ingest_ship"
+            )
+        except BaseException as e:
+            # A dead/closed scheduler must not wedge ingest behind a
+            # leaked in-flight flag, and must surface through the
+            # contracted IngestError path (_check_shipper), not as a raw
+            # TransferError from whoever happened to stage rows.
+            self._ingest_inflight = False
+            self._ingest_exc = self._ingest_exc or e
+
+    def _scheduled_drain_step(self) -> int:
+        """One scheduler-dispatched super-block ship. Re-arms itself while
+        full blocks remain (one item in flight at a time, so the fair
+        queue can interleave prefetch between super-blocks); failures park
+        in _ingest_exc for the producer's bounded-restart check. Returns
+        bytes moved (the scheduler's fair-queue currency)."""
+        try:
+            shipped = self._drain_step()
+        except BaseException as e:
+            with self._staging:
+                self._ingest_inflight = False
+                self._ingest_exc = e
+                self._staging.notify_all()  # unblock backpressure waiters
+            return 0
+        with self._staging:
+            self._ingest_inflight = False
+            self._submit_ingest_locked()
+        return shipped * self.width * 4
 
     def add_packed(self, block: np.ndarray) -> None:
         """Stage packed [M, D] rows in the host ring; ship in fixed-size
@@ -385,6 +552,7 @@ class DeviceReplay:
             self._ring.push(rows)
             self._stats.record_push(len(rows), stall)
             self._staging.notify_all()
+            self._submit_ingest_locked()
         if self._procs > 1 or self._async:
             return
         self._drain_ring()
@@ -448,17 +616,55 @@ class DeviceReplay:
                 moved += self.pending_rows
                 self.flush()
             return moved
+        if self._bg_sync:
+            # Background-beat mode: even a synchronous caller must route
+            # through the scheduler's lockstep lane — with beats possibly
+            # queued ahead, a collective that bypassed the lane would
+            # execute in a different order on different processes and
+            # mismatch (docs/TRANSFER.md token protocol).
+            return self.sync_ship_begin(force=force).result(timeout=600.0)
+        return self._sync_ship_collective(force)
 
-        from jax.experimental import multihost_utils
+    def sync_ship_begin(self, force: bool = False):
+        """Issue one lockstep ingest beat on the transfer scheduler's
+        ordered lane and return its TransferTicket WITHOUT waiting — the
+        background sync_ship mode (docs/TRANSFER.md). ALL processes must
+        issue beats at the same points in the same order (train_jax's
+        lockstep loop guarantees it), and the caller must wait the ticket
+        before its next collective-bearing dispatch so per-process
+        enqueue order stays identical. Each beat reads its pending count
+        when it EXECUTES on the lane — strictly after every earlier beat
+        (FIFO), so rows are never claimed twice; replicas agree because
+        the shipped quantity derives from the all-gathered min, and the
+        FIFO grouping invariance (_coalesce_k) keeps the final storage
+        bit-identical to the synchronous reference."""
+        if not self._bg_sync:
+            raise RuntimeError(
+                "sync_ship_begin() needs background_sync=True, an attached "
+                "TransferScheduler, and a multi-process mesh"
+            )
+        self._beat += 1
+        return self._sched.submit(
+            "lockstep",
+            lambda: self._sync_ship_collective(force),
+            label=f"sync_ship_beat_{self._beat}",
+        )
+
+    def _sync_ship_collective(self, force: bool) -> int:
+        # Count read at execution time (see sync_ship_begin): the staged
+        # rows not consumed by any earlier beat. `count - moved` below is
+        # stable against rows the producer stages concurrently — those
+        # belong to a later beat.
+        count = self.pending_rows
+        from distributed_ddpg_tpu.parallel.multihost import allgather_scalar
 
         # One span over the whole lockstep beat (count all-gather +
-        # ships): on the timeline this is the learner thread blocked on
-        # the DCN collective — the cost the ROADMAP lockstep-token item
-        # wants to overlap, now measurable per beat.
-        with trace.span("sync_ship"):
-            counts = np.asarray(
-                multihost_utils.process_allgather(np.int32(self.pending_rows))
-            )
+        # ships): on the timeline this is the calling thread blocked on
+        # the DCN collective — in background mode the span lands on the
+        # transfer-sched track, overlapping the learner's chunk compute
+        # (the overlap the ROADMAP lockstep-token item asked for).
+        with trace.span("sync_ship", beat=self._beat):
+            counts = allgather_scalar(np.int32(count))
             m = int(counts.min())
             moved = 0
             cap_blocks = self.capacity // (self._procs * self.block_size)
@@ -480,7 +686,11 @@ class DeviceReplay:
                     moved += k * self.block_size
                     remaining -= k
                 if force and m % self.block_size:
-                    take = min(self.pending_rows, self.block_size)
+                    # Pad from the SNAPSHOT remainder (count was captured
+                    # at token time): rows staged after the token belong
+                    # to a later beat, and in background mode the producer
+                    # may have staged more since.
+                    take = min(count - moved, self.block_size)
                     with self._staging:
                         rows = self._ring.pop(take)
                     reps = -(-self.block_size // take)

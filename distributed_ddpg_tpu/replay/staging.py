@@ -83,6 +83,23 @@ class HostStagingRing:
         self._size -= n
         return out
 
+    def pop_into(self, n: int, out: np.ndarray) -> np.ndarray:
+        """pop(), but into a caller-owned buffer (the transfer host-buffer
+        pool, transfer/hostbuf.py) — same FIFO semantics, zero allocation."""
+        if n > self._size:
+            raise ValueError(f"pop_into({n}) from ring holding {self._size}")
+        if out.shape != (n, self.width):
+            raise ValueError(
+                f"pop_into needs a [{n}, {self.width}] buffer, got {out.shape}"
+            )
+        first = min(n, self.capacity - self._head)
+        out[:first] = self._buf[self._head : self._head + first]
+        if n > first:
+            out[first:] = self._buf[: n - first]
+        self._head = (self._head + n) % self.capacity
+        self._size -= n
+        return out
+
     def peek(self, n: int) -> np.ndarray:
         """Copy of the n oldest rows without consuming them."""
         if n > self._size:

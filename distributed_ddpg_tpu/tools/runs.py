@@ -156,9 +156,11 @@ KEY_METRICS = (
 RECOVERY_KEYS = (
     "actor_respawns",
     "actor_quarantined",
+    "actor_unquarantined",
     "ckpt_write_retries",
     "emergency_ckpt",
     "ingest_shipper_restarts",
+    "transfer_restarts",
 )
 
 
@@ -216,6 +218,22 @@ def summarize_run(path: str) -> Dict[str, Any]:
             ingest[key] = {"steady": _tail_mean(vals), "max": max(vals)}
     digest["ingest"] = ingest
 
+    # Transfer-scheduler digest (docs/TRANSFER.md): per-class dispatch
+    # counters/tails, queue depths, and the adaptive-coalesce trajectory
+    # (cap gauge + cumulative grows/shrinks).
+    transfer = {}
+    transfer_keys = sorted(
+        {
+            k for r in train for k in r
+            if k.startswith("transfer_") and k not in RECOVERY_KEYS
+        }
+    )
+    for key in transfer_keys:
+        vals = _col(train, key)
+        if vals:
+            transfer[key] = {"steady": _tail_mean(vals), "max": max(vals)}
+    digest["transfer"] = transfer
+
     recovery = {}
     for key in RECOVERY_KEYS:
         vals = _col(train + final, key)
@@ -271,6 +289,15 @@ def render_summary(digest: Dict[str, Any]) -> str:
             [
                 [k, v["steady"], v["max"]]
                 for k, v in digest["ingest"].items()
+            ],
+        ))
+    if digest.get("transfer"):
+        out.append("\n-- transfer scheduler (docs/TRANSFER.md)")
+        out.append(render_table(
+            ["field", "steady", "max"],
+            [
+                [k, v["steady"], v["max"]]
+                for k, v in digest["transfer"].items()
             ],
         ))
     if digest.get("recovery"):
@@ -336,6 +363,16 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
         ib = b["ingest"].get(key, {})
         add(key, ia.get("steady"), ib.get("steady"),
             lower_better=("stall" in key or "queue" in key or "_ms" in key))
+    for key in sorted(
+        set(a.get("transfer", {})) | set(b.get("transfer", {}))
+    ):
+        ta = a.get("transfer", {}).get(key, {})
+        tb = b.get("transfer", {}).get(key, {})
+        add(key, ta.get("steady"), tb.get("steady"),
+            lower_better=(
+                "queue" in key or "_ms" in key or "p95" in key
+                or "fence" in key
+            ))
     for key in sorted(set(a.get("recovery", {})) | set(b.get("recovery", {}))):
         ra = a.get("recovery", {}).get(key, {})
         rb = b.get("recovery", {}).get(key, {})

@@ -511,6 +511,25 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     except ValueError:
         pass  # not on the main thread (embedded callers): no handler
 
+    # --- unified transfer scheduler (transfer/; docs/TRANSFER.md) ---
+    # One dispatch thread owns replay-ingest super-blocks, prefetch chunk
+    # h2d, learner d2h accounting, and (multi-host) the lockstep ingest
+    # collective's background beats. Off under strict_sync: scheduler
+    # dispatch timing would make the metrics stream host-scheduling-
+    # dependent, breaking the bit-identical-two-runs contract. Created
+    # after the fail-fast config checks so an early ValueError cannot
+    # leak the dispatch thread.
+    transfer_sched = None
+    if config.transfer_scheduler and not config.strict_sync:
+        from distributed_ddpg_tpu.transfer import TransferScheduler
+
+        transfer_sched = TransferScheduler(
+            fault=(
+                fault_plan.site("transfer", "dispatch")
+                if fault_plan else None
+            ),
+        ).start()
+
     learner = ShardedLearner(
         config,
         spec.obs_dim,
@@ -541,6 +560,23 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             fault=(
                 fault_plan.site("shipper", "ship") if fault_plan else None
             ),
+            # Transfer-scheduler policies (docs/TRANSFER.md): scheduled
+            # ingest work items, adaptive coalesce cap, pooled staging
+            # buffers, and (multi-host) background sync_ship beats. ALL
+            # gated on the scheduler actually running: strict_sync and
+            # transfer_scheduler=False must recover the PR-1 pipeline
+            # verbatim (the adaptive cap is wall-clock-driven, so letting
+            # it run under strict_sync would break the bit-identical-
+            # metrics contract).
+            scheduler=transfer_sched,
+            adaptive_coalesce=(
+                transfer_sched is not None
+                and config.ingest_coalesce_adaptive
+            ),
+            host_pool=(
+                transfer_sched is not None and config.transfer_host_pool
+            ),
+            background_sync=config.sync_ship_background,
         )
         device_replay = (
             DevicePrioritizedReplay(
@@ -604,6 +640,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             f"resumed from {config.checkpoint_dir} at learner step {step}, "
             f"env step {env_steps_offset}"
         )
+
+    # Learner d2h pulls ride the scheduler's inline d2h class: absolute
+    # priority (no queueing on the hot path), full transfer_* accounting.
+    learner.transfer = transfer_sched
 
     pool.start(learner.actor_params_to_host())
     _beat()  # first params d2h survived (an observed wedge point)
@@ -689,6 +729,43 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # has no shared host state at all.
     replay_lock = threading.Lock()
 
+    # --- background lockstep sync_ship (docs/TRANSFER.md) ---
+    # With the scheduler attached on a multi-host run, the per-chunk
+    # sync_ship collective is issued as a BACKGROUND beat on the lockstep
+    # lane (pending counts snapshot at issue time) and the learner only
+    # gates its NEXT collective-bearing dispatch on the beat's enqueue —
+    # the DCN wait overlaps chunk compute instead of blocking the loop.
+    # Warmup keeps synchronous semantics: its loop condition reads the
+    # replicated buffer fill, which must reflect the beat on every
+    # process at the same iteration or the lockstep loop counts fork.
+    bg_sync = (
+        transfer_sched is not None
+        and is_multi
+        and use_device_replay
+        and config.sync_ship_background
+    )
+    pending_beat: Dict[str, object] = {"t": None}
+
+    def wait_beat() -> None:
+        """Gate: resolve the outstanding background beat (if any) before
+        the next collective-bearing dispatch / replica-state read. The
+        residual non-overlapped cost lands in t_sync_ship_wait_*."""
+        t = pending_beat["t"]
+        if t is not None:
+            pending_beat["t"] = None
+            with phases.phase("sync_ship_wait"):
+                t.result(timeout=600.0)
+
+    def transfer_fields() -> Dict[str, float]:
+        """transfer_* observability for the JSONL records: scheduler
+        counters + the replay-owned adaptive-coalesce/pool gauges."""
+        if transfer_sched is None:
+            return {}
+        out = dict(transfer_sched.snapshot())
+        if use_device_replay and device_replay is not None:
+            out.update(device_replay.transfer_snapshot())
+        return out
+
     def drain() -> int:
         # Ingest rate limiter (config.max_ingest_ratio): when the budget is
         # exhausted, skip draining — transports fill and workers block,
@@ -726,19 +803,31 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         with replay_lock:
             return pool.drain_into(replay, max_rows=max_rows)
 
-    def ingest_once(force_ship: bool = False) -> int:
+    def ingest_once(force_ship: bool = False, sync_wait: bool = True) -> int:
         """One ingest beat: drain actor transports (timed), then — multi-host
         only — the UNCONDITIONAL lockstep sync_ship collective. Every site
         that ingests on the hot path must go through here: the drain gate
         uses process-LOCAL counters, so the collective must not be skippable
         on some processes (replay/device.py sync_ship). Single-process,
         add_packed only stages into the host ring when the async shipper is
-        on — the device work happens off this thread (docs/INGEST.md)."""
+        on — the device work happens off this thread (docs/INGEST.md).
+
+        sync_wait=False (steady-state loop, bg_sync mode) issues the
+        collective as a background beat and leaves the ticket pending;
+        wait_beat() resolves it before the next dispatch. Exactly one
+        beat is ever outstanding — each issue waits its predecessor."""
         with phases.phase("ingest"):
             moved = drain()
             env_timer.tick(moved)
         if use_device_replay and is_multi:
-            device_replay.sync_ship(force=force_ship)
+            wait_beat()  # at most one outstanding beat (no-op if none)
+            if bg_sync and not sync_wait and not force_ship:
+                pending_beat["t"] = device_replay.sync_ship_begin()
+            else:
+                # force / warmup: synchronous semantics (still routed
+                # through the lockstep lane in bg mode — replay/device.py
+                # sync_ship keeps the collective order identical).
+                device_replay.sync_ship(force=force_ship)
         return moved
 
     def buffer_fill() -> int:
@@ -753,14 +842,21 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         agreed — a process-local condition would let processes exit at
         different iterations and deadlock the rest on the next collective.
         (total_env_steps is therefore a GLOBAL budget on multi-host runs:
-        64 actors across 4 hosts share it.)"""
-        from jax.experimental import multihost_utils
+        64 actors across 4 hosts share it.) In bg_sync mode the gather
+        runs on the scheduler's lockstep lane: with background sync_ship
+        beats possibly queued, NO host-initiated collective may bypass
+        the lane or the per-process collective order would fork
+        (docs/TRANSFER.md)."""
+        from distributed_ddpg_tpu.parallel.multihost import allgather_scalar
 
-        return int(
-            np.asarray(
-                multihost_utils.process_allgather(np.int64(env_steps()))
-            ).sum()
-        )
+        def gather() -> int:
+            return int(allgather_scalar(np.int64(env_steps())).sum())
+
+        if bg_sync:
+            return transfer_sched.run_ordered(
+                gather, label="env_steps_allgather"
+            )
+        return gather()
 
     next_refresh = 0
     last_eval = 0
@@ -781,7 +877,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         nonlocal last_refresh_t, last_log_t
         learn_steps += chunk
         learn_timer.tick(chunk)
-        ingest_once()
+        ingest_once(sync_wait=False)
 
         if config.prioritized and not use_device_replay:
             # Host PER: priorities live in the CPU sum-tree; the device path
@@ -813,6 +909,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         chunk_metrics = None
         support_metrics = {}
         if on_cadence and config.distributional and config.v_support_auto:
+            # Replica-state read below (replay_data_bounds pulls reward
+            # columns from the replicated storage): the outstanding
+            # background beat must land first so every process reads the
+            # identical buffer state at this cadence point.
+            wait_beat()
             # Running expansion (ops/support_auto.py): mean_q drifting
             # toward a support edge means the critic is about to saturate
             # (projection clips, mean_q can never cross the edge) — push
@@ -886,6 +987,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     if use_device_replay
                     else {}
                 ),
+                # Transfer-scheduler observability (docs/TRANSFER.md):
+                # per-class dispatches/bytes/tails, queue depths, the
+                # adaptive-coalesce trajectory, restart count.
+                **transfer_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -1023,6 +1128,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     fault_plan.site("prefetch", "sample")
                     if fault_plan else None
                 ),
+                # Single-process only: multi-host put_chunk is itself a
+                # cross-process device op, and only the lockstep lane may
+                # issue those off the learner thread (docs/TRANSFER.md).
+                scheduler=(transfer_sched if not is_multi else None),
             ).start()
 
         # Rates below report the steady state, not compile/warmup time.
@@ -1107,10 +1216,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     # process skips the same iterations and the SPMD
                     # collective schedule stays aligned (same reasoning as
                     # the loop-exit condition above).
-                    if not ingest_once():
+                    if not ingest_once(sync_wait=False):
                         time.sleep(0.002)
                     it += 1
                     continue
+                # Dispatch gate (bg_sync): the previous background beat
+                # must be ENQUEUED before the next chunk dispatch so the
+                # per-process device-op order stays identical everywhere
+                # (docs/TRANSFER.md token protocol). No-op otherwise.
+                wait_beat()
                 if use_device_replay:
                     if config.prioritized:
                         # beta anneal rides in as a scalar arg. It must be
@@ -1202,12 +1316,24 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             except (ValueError, TypeError):
                 pass
         _beat()  # each teardown stage gets a fresh watchdog allowance
+        try:
+            # Land the outstanding background sync_ship beat (every
+            # process issued the same beats, so every process waits here)
+            # before tearing down the machinery under it.
+            wait_beat()
+        except Exception:
+            pass  # a failing beat must not mask the primary error
         pool.stop()
         _beat()
         if use_device_replay and device_replay is not None:
             # Stop the async ingest shipper; add_packed falls back to
             # inline shipping for any teardown stragglers.
             device_replay.close()
+        if transfer_sched is not None:
+            # After the replay detaches: pending tickets fail loudly into
+            # their waiters (a still-running prefetch worker dies with
+            # TransferError instead of hanging).
+            transfer_sched.close()
         # Land the in-flight checkpoint write (and surface its error, if
         # any) before callers read the directory back.
         saver.wait()
@@ -1233,6 +1359,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         final_return=final_return,
         **recovery_fields(),
         **phases.snapshot(),
+        **transfer_fields(),
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the

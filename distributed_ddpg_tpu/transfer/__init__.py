@@ -1,0 +1,32 @@
+"""Unified host<->device transfer scheduling (docs/TRANSFER.md).
+
+One subsystem owns every host<->device stream the trainer produces —
+inbound replay ingest super-blocks, outbound chunk-prefetch h2d, learner
+params/metrics d2h, and the multi-host lockstep ingest collective —
+replacing the two private per-component threads (the `_IngestShipper` in
+replay/device.py and the `ChunkPrefetcher`'s inline `device_put`) that
+previously competed blindly for h2d bandwidth.
+
+  - scheduler.TransferScheduler: the single dispatch thread + prioritized
+    work classes with fair bandwidth balancing.
+  - adaptive.AdaptiveCoalesce: the ingest_coalesce controller (grow while
+    the staging queue trends up, shrink when dispatch stall appears).
+  - hostbuf.HostBufferPool: reusable staged host buffers for super-block
+    device_put, fenced on the consuming insert's output.
+"""
+
+from distributed_ddpg_tpu.transfer.adaptive import AdaptiveCoalesce
+from distributed_ddpg_tpu.transfer.hostbuf import HostBufferPool
+from distributed_ddpg_tpu.transfer.scheduler import (
+    TransferError,
+    TransferScheduler,
+    TransferTicket,
+)
+
+__all__ = [
+    "AdaptiveCoalesce",
+    "HostBufferPool",
+    "TransferError",
+    "TransferScheduler",
+    "TransferTicket",
+]

@@ -1,0 +1,105 @@
+"""Adaptive ingest-coalesce controller (docs/TRANSFER.md).
+
+`config.ingest_coalesce` was a static cap on how many staged blocks fold
+into one super-block ship (replay/device.py). The right value depends on
+the actor:learner throughput ratio, which varies per env, per host, and
+over a run's lifetime (ROADMAP: "an adaptive controller — grow k while
+ingest_queue_rows trends up, shrink when stall appears — would self-tune
+across actor:learner throughput ratios"). This controller owns the
+EFFECTIVE cap, a power of two in [1, hi]:
+
+  - GROW (x2) when, after a ship, the staging queue still holds at least
+    one full super-block at the current cap — inflow is outpacing the
+    dispatch cadence, so bigger super-blocks amortize better.
+  - SHRINK (/2) when a full-cap ship's per-block dispatch time blows past
+    `stall_ratio` x the EWMA — a dispatch stall (backend congestion, a
+    competing transfer class, host memory pressure) means smaller ships
+    release the bus sooner and interleave better.
+
+Correctness does not depend on the cap sequence: the coalesced scatter
+lands every row at exactly the serial sequence's position for ANY k
+(replay/device.py `_coalesce_k` invariant), so the controller can only
+change WHEN rows land, never WHERE — the adaptive parity tests in
+tests/test_ingest_pipeline.py assert storage stays bit-identical to the
+serial reference under an adversarially jittered cap.
+
+Multi-host note: lockstep `sync_ship` derives its k sequence from an
+all-gathered minimum and must be identical on every process, while this
+controller is driven by process-LOCAL wall-clock timings — so it applies
+ONLY to single-process shipping paths; the collective path keeps the
+static cap (replay/device.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class AdaptiveCoalesce:
+    def __init__(
+        self,
+        hi: int,
+        block_size: int,
+        lo: int = 1,
+        stall_ratio: float = 3.0,
+        ewma_alpha: float = 0.2,
+    ):
+        if lo < 1 or hi < lo:
+            raise ValueError(f"need 1 <= lo <= hi, got lo={lo} hi={hi}")
+        self._lo = 1 << (int(lo).bit_length() - 1)
+        self._hi = 1 << (int(hi).bit_length() - 1)
+        self._block = int(block_size)
+        self._ratio = float(stall_ratio)
+        self._alpha = float(ewma_alpha)
+        # Start at the floor and earn headroom from observed backlog: the
+        # first ships after a quiet period stay small (short bus holds),
+        # and a sustained flood reaches the ceiling in log2(hi) ships.
+        self._cap = self._lo
+        self._ewma_per_block = 0.0
+        self.grows = 0
+        self.shrinks = 0
+        self._lock = threading.Lock()
+
+    def cap(self) -> int:
+        """Current effective max_coalesce (power of two in [lo, hi])."""
+        return self._cap
+
+    def observe_ship(self, blocks: int, ship_s: float, queue_rows: int) -> None:
+        """Feed one completed ship: blocks coalesced, dispatch wall time,
+        and the staging-queue depth AFTER the pop. Called from whichever
+        thread shipped (scheduler or inline); cheap and lock-tight."""
+        if blocks <= 0:
+            return
+        per_block = ship_s / blocks
+        with self._lock:
+            prev = self._ewma_per_block
+            self._ewma_per_block = (
+                per_block
+                if prev == 0.0
+                else (1.0 - self._alpha) * prev + self._alpha * per_block
+            )
+            if (
+                prev > 0.0
+                and per_block > self._ratio * prev
+                and self._cap > self._lo
+            ):
+                # Dispatch stall: back off before growing again.
+                self._cap >>= 1
+                self.shrinks += 1
+            elif (
+                queue_rows >= self._cap * self._block
+                and self._cap < self._hi
+            ):
+                # Backlog still holds a full next-size super-block: grow.
+                self._cap <<= 1
+                self.grows += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        """The adaptive-trajectory observability fields riding the
+        transfer_* family (cap is a gauge; grows/shrinks cumulative)."""
+        return {
+            "transfer_coalesce_cap": self._cap,
+            "transfer_coalesce_grows": self.grows,
+            "transfer_coalesce_shrinks": self.shrinks,
+        }

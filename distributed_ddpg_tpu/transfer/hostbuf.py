@@ -1,0 +1,108 @@
+"""Staged host-buffer pool for super-block device_put (docs/TRANSFER.md).
+
+The ingest path used to materialize every super-block with a fresh
+`np.empty` (`HostStagingRing.pop`'s owned copy) and hand that pageable
+allocation to `jax.device_put`. On TPU hosts the runtime then stages the
+pageable pages into its own transfer buffer — a copy that lands inside
+`ingest_ship_ms` on the dispatching thread (ROADMAP: "Staged super-block
+device_put goes through pageable host memory; a pinned-buffer pool ...
+would cut the host-side copy out of ingest_ship_ms").
+
+`HostBufferPool` keeps a small set of long-lived buffers per super-block
+shape (the power-of-two coalesce sizes give a bounded key set) and
+recycles them double-buffered:
+
+  acquire(rows)            -> a writable [rows, width] float32 buffer
+  commit(buf, fence)       -> returns the buffer to the pool; it is not
+                              handed out again until `fence` (a device
+                              array produced by the op that CONSUMED the
+                              transferred data — replay uses the insert's
+                              output `size` scalar) reports ready.
+
+Fencing on the consumer's OUTPUT — not on the device_put result — makes
+reuse safe even when the backend aliases host memory zero-copy (dlpack
+or CPU fast paths): the buffer only recirculates after the insert that
+read it has executed. On backends that copy eagerly the fence is already
+satisfied by the time the next ship needs the buffer, so steady state
+never blocks and never allocates.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class HostBufferPool:
+    def __init__(self, width: int, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.width = int(width)
+        self.depth = int(depth)
+        self._lock = threading.Lock()
+        self._free: Dict[int, deque] = {}
+        # rows -> deque of (buf, fence) awaiting their consumer.
+        self._inflight: Dict[int, deque] = {}
+        self._allocated: Dict[int, int] = {}
+        self.allocations = 0
+        self.fence_waits = 0
+
+    def acquire(self, rows: int) -> np.ndarray:
+        """A writable [rows, width] float32 buffer. Recycles a free one,
+        allocates while under `depth` buffers for this shape, else blocks
+        on the oldest in-flight fence (classic double buffering)."""
+        rows = int(rows)
+        fence_entry = None
+        with self._lock:
+            free = self._free.setdefault(rows, deque())
+            if free:
+                return free.popleft()
+            inflight = self._inflight.setdefault(rows, deque())
+            if self._allocated.get(rows, 0) < self.depth or not inflight:
+                # Under depth, OR every pooled buffer for this shape was
+                # lost (a caller that failed between acquire and commit):
+                # allocate rather than crash — a leak degrades to the
+                # unpooled behavior, it must never mask the real error.
+                self._allocated[rows] = self._allocated.get(rows, 0) + 1
+                self.allocations += 1
+                return np.empty((rows, self.width), np.float32)
+            fence_entry = inflight.popleft()
+        # Wait OUTSIDE the lock: the fence completes on the device stream
+        # regardless of host locks, and commit() must stay callable.
+        buf, fence = fence_entry
+        self.fence_waits += 1
+        _wait_fence(fence)
+        return buf
+
+    def commit(self, buf: np.ndarray, fence) -> None:
+        """Return `buf` to the pool, gated on `fence` (any object with
+        block_until_ready/is_ready, or None for an immediate return)."""
+        rows = buf.shape[0]
+        with self._lock:
+            if fence is None:
+                self._free.setdefault(rows, deque()).append(buf)
+            else:
+                self._inflight.setdefault(rows, deque()).append((buf, fence))
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "transfer_pool_buffers": sum(self._allocated.values()),
+                "transfer_pool_fence_waits": self.fence_waits,
+            }
+
+
+def _wait_fence(fence) -> None:
+    """Block until a device array is safe to overwrite its source for —
+    i.e. its producing computation (which consumed the host buffer) has
+    executed. Tolerates deleted/donated arrays and foreign objects: a
+    fence that cannot be queried is treated as already satisfied (the
+    conservative direction for copying backends, the only ones that can
+    produce such a fence)."""
+    try:
+        fence.block_until_ready()
+    except Exception:
+        pass

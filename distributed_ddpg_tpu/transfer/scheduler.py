@@ -1,0 +1,371 @@
+"""The unified host<->device transfer scheduler (docs/TRANSFER.md).
+
+Podracer-style TPU architectures (PAPERS.md arXiv 2104.06272) and
+TorchBeast's actor->learner ingest (arXiv 1910.03552) draw their
+throughput from the same discipline: treat host<->device transfer as ONE
+scheduled resource overlapping compute, instead of letting each component
+own a private thread that competes blindly for the bus. Before this
+module the repo had exactly that anti-pattern — `_IngestShipper`
+(replay/device.py) and `ChunkPrefetcher` (parallel/prefetch.py) each ran
+their own daemon thread and queue, and the PR-3 flight-recorder timelines
+(`ingest_ship` / `prefetch_h2d` spans landing back-to-back on separate
+tracks) showed them serializing against each other on the transfer
+stream with no policy at all.
+
+`TransferScheduler` is one dispatch thread plus prioritized work classes:
+
+  lockstep   multi-host collective beats (background sync_ship + any
+             other host-initiated collective). STRICT FIFO and absolute
+             priority: every process must execute the identical sequence
+             of collectives in the identical order, so these never
+             reorder against each other (docs/TRANSFER.md has the token
+             protocol).
+  ingest     inbound staged-replay super-blocks (h2d + jitted insert).
+  prefetch   outbound sampled-chunk h2d (host-replay mode).
+  d2h        learner params/metrics pulls. These are learner-critical
+             and synchronous by nature, so they run INLINE on the caller
+             thread with absolute priority — the scheduler accounts
+             their bytes/latency (they feed the balance bookkeeping and
+             the transfer_* observability) without adding queueing
+             latency to the hot path.
+
+Between `ingest` and `prefetch` the scheduler start-time fair-queues by
+bytes (virtual-time per class, weight-scaled): under an ingest flood a
+newly arrived prefetch item is picked as soon as the in-flight item
+finishes, and vice versa — neither stream can starve the other by more
+than one item's dispatch time (tests/test_transfer.py pins the bound).
+A class idle for a long stretch re-enters at the current virtual time,
+so it cannot bank unbounded credit and then starve everyone else.
+
+Failure contract (mirrors `_IngestShipper`): an exception thrown by a
+work item lands in that item's ticket (the submitter's problem — replay
+ingest turns it into its bounded-restart/IngestError path); an exception
+in the scheduler LOOP itself (including an injected
+`transfer:dispatch:crash@k` fault, faults.py) kills the thread, which
+restarts itself up to `max_restarts` times (`transfer_restarts` counter,
+`transfer_restart` trace instant) — within the budget the crash is
+TRANSPARENT to submitters: the not-yet-executed in-flight item returns
+to the head of its queue and runs on the restarted thread (no prefetch
+worker or lockstep beat dies because the scheduler hiccuped). Past the
+budget the scheduler declares itself dead and every pending and future
+ticket raises `TransferError`.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from distributed_ddpg_tpu import trace
+from distributed_ddpg_tpu.metrics import TransferStats
+
+# Work classes. Order here is documentation only; scheduling policy is
+# lockstep-first, then byte-fair between ingest/prefetch, d2h inline.
+LOCKSTEP = "lockstep"
+INGEST = "ingest"
+PREFETCH = "prefetch"
+D2H = "d2h"
+
+_QUEUED_CLASSES = (LOCKSTEP, INGEST, PREFETCH)
+
+
+class TransferError(RuntimeError):
+    """The transfer scheduler thread is dead (restart budget exhausted) —
+    the original exception rides along as __cause__, mirroring
+    replay.device.IngestError's surfacing discipline."""
+
+
+class TransferTicket:
+    """Completion handle for one submitted work item. `result()` returns
+    the item's return value, re-raises the item's exception, or raises
+    TransferError if the scheduler died before the item ran."""
+
+    __slots__ = ("label", "_done", "_result", "_exc")
+
+    def __init__(self, label: str = ""):
+        self.label = label
+        self._done = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def _finish(self, result=None, exc: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._exc = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block up to `timeout` for completion; True when done. Unlike
+        result(), never raises — the stop-responsive polling wait for
+        callers that must keep checking their own shutdown flags."""
+        return self._done.wait(timeout)
+
+    @property
+    def exception(self) -> Optional[BaseException]:
+        return self._exc if self._done.is_set() else None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"transfer item {self.label or '<unnamed>'} not done "
+                f"within {timeout}s"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Item:
+    __slots__ = ("cls", "fn", "nbytes", "ticket")
+
+    def __init__(self, cls: str, fn: Callable, nbytes: int, ticket: TransferTicket):
+        self.cls = cls
+        self.fn = fn
+        self.nbytes = int(nbytes)
+        self.ticket = ticket
+
+
+class TransferScheduler:
+    def __init__(
+        self,
+        stats: Optional[TransferStats] = None,
+        fault=None,
+        max_restarts: int = 3,
+        weights: Optional[Dict[str, float]] = None,
+    ):
+        self.stats = stats or TransferStats()
+        # Chaos harness (faults.py): ticked once per dequeued item, OUTSIDE
+        # the per-item try — transfer:dispatch:crash@k therefore kills the
+        # scheduler THREAD (the bounded-restart path under test), while a
+        # work item's own exception only fails its ticket.
+        self._fault = fault
+        self._max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._cv = threading.Condition()
+        self._queues: Dict[str, deque] = {c: deque() for c in _QUEUED_CLASSES}
+        # Start-time fair queuing state: per-class virtual time advanced by
+        # bytes/weight on dispatch; an empty class re-enters at the global
+        # virtual time so idle periods never bank starvation-scale credit.
+        self._weights = {INGEST: 1.0, PREFETCH: 1.0, **(weights or {})}
+        self._vt = {INGEST: 0.0, PREFETCH: 0.0}
+        self._global_vt = 0.0
+        self._stop = False
+        self._dead_exc: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle ---
+
+    def start(self) -> "TransferScheduler":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="transfer-sched"
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the dispatch thread. Queued-but-undispatched tickets fail
+        with TransferError BEFORE the join — close() must not execute
+        stale work (a queued lockstep beat run at teardown would fire a
+        collective against a cluster that may already be gone); only the
+        single in-flight item (if any) runs to completion. Submitters
+        that need their items landed must flush() first."""
+        with self._cv:
+            self._stop = True
+        self._fail_pending(TransferError("transfer scheduler closed"))
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        # A straggler that raced the stop flag (submitted between the
+        # fail and the join) still gets failed, not stranded.
+        self._fail_pending(TransferError("transfer scheduler closed"))
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until every currently queued item has been dispatched
+        (their tickets resolved, successfully or not)."""
+        deadline = time.monotonic() + timeout
+        tickets = []
+        with self._cv:
+            for q in self._queues.values():
+                tickets.extend(item.ticket for item in q)
+        for t in tickets:
+            t._done.wait(max(0.0, deadline - time.monotonic()))
+
+    @property
+    def alive(self) -> bool:
+        return (
+            self._thread is not None
+            and self._thread.is_alive()
+            and self._dead_exc is None
+        )
+
+    # --- submission ---
+
+    def submit(
+        self, cls: str, fn: Callable, nbytes: int = 0, label: str = ""
+    ) -> TransferTicket:
+        """Queue one transfer work item; returns its ticket. An INGEST
+        callable may return an int to report the actual bytes moved (the
+        size is unknown at submit time under coalescing); other classes'
+        return values are payloads delivered through the ticket."""
+        if cls not in _QUEUED_CLASSES:
+            raise ValueError(f"unknown transfer class {cls!r}")
+        ticket = TransferTicket(label or cls)
+        with self._cv:
+            if self._dead_exc is not None:
+                raise TransferError(
+                    "transfer scheduler thread died"
+                ) from self._dead_exc
+            if self._stop:
+                raise TransferError("transfer scheduler closed")
+            q = self._queues[cls]
+            if cls in self._vt and not q:
+                # Class re-enters the fair queue at the current virtual
+                # time (see module docstring).
+                self._vt[cls] = max(self._vt[cls], self._global_vt)
+            q.append(_Item(cls, fn, nbytes, ticket))
+            self.stats.record_queue_depth(cls, len(q))
+            self._cv.notify_all()
+        return ticket
+
+    def run_ordered(self, fn: Callable, label: str = "", timeout: float = 600.0):
+        """Execute `fn` on the scheduler thread in the LOCKSTEP lane and
+        wait for its result. Multi-host callers route every host-initiated
+        collective outside jitted chunk dispatch through here so all
+        processes execute the identical collective sequence in the
+        identical order (docs/TRANSFER.md token protocol)."""
+        return self.submit(LOCKSTEP, fn, label=label).result(timeout=timeout)
+
+    def run_inline(self, cls: str, fn: Callable, nbytes_of=None, label: str = ""):
+        """Execute `fn` on the CALLER's thread, accounting it as transfer
+        traffic of class `cls` (learner-critical d2h: absolute priority,
+        zero queueing latency, full observability)."""
+        t0 = time.perf_counter()
+        with trace.span(f"transfer_{cls}", label=label):
+            result = fn()
+        nbytes = int(nbytes_of(result)) if nbytes_of is not None else 0
+        self.stats.record_dispatch(cls, nbytes, time.perf_counter() - t0)
+        return result
+
+    def queue_depths(self) -> Dict[str, int]:
+        with self._cv:
+            return {c: len(q) for c, q in self._queues.items()}
+
+    def snapshot(self) -> Dict[str, float]:
+        """The transfer_* observability fields (metrics.TransferStats),
+        including current queue depths and the cumulative restart count."""
+        return self.stats.snapshot(
+            queue_depths=self.queue_depths(), restarts=self.restarts
+        )
+
+    # --- dispatch loop ---
+
+    def _pick_locked(self) -> Optional[_Item]:
+        if self._queues[LOCKSTEP]:
+            return self._queues[LOCKSTEP].popleft()
+        backlogged = [c for c in (INGEST, PREFETCH) if self._queues[c]]
+        if not backlogged:
+            return None
+        cls = min(backlogged, key=lambda c: self._vt[c])
+        return self._queues[cls].popleft()
+
+    def _charge(self, cls: str, nbytes: int) -> None:
+        if cls in self._vt:
+            # Floor of one unit per item so zero-byte probes still rotate.
+            self._vt[cls] += max(nbytes, 1) / self._weights.get(cls, 1.0)
+            self._global_vt = self._vt[cls]
+
+    def _run(self) -> None:
+        item: Optional[_Item] = None
+        try:
+            while True:
+                with self._cv:
+                    item = self._pick_locked()
+                    while item is None and not self._stop:
+                        self._cv.wait(0.1)
+                        item = self._pick_locked()
+                    if item is None and self._stop:
+                        return
+                if self._fault is not None:
+                    self._fault.tick()
+                self._dispatch(item)
+                item = None  # completed: never requeued by a later crash
+        except BaseException as e:
+            self._on_thread_death(e, item)
+
+    def _dispatch(self, item: _Item) -> None:
+        t0 = time.perf_counter()
+        try:
+            with trace.span(f"transfer_{item.cls}", label=item.ticket.label):
+                ret = item.fn()
+        except BaseException as e:  # the submitter's problem, not ours
+            self.stats.record_dispatch(
+                item.cls, item.nbytes, time.perf_counter() - t0
+            )
+            self._charge(item.cls, item.nbytes)
+            item.ticket._finish(exc=e)
+            return
+        # Ingest items report the bytes they moved via their return value
+        # (the size is unknown at submit time — coalescing). ONLY the
+        # ingest class gets this reading: other classes' integer results
+        # are payloads (a lockstep beat returns rows moved, run_ordered
+        # returns arbitrary values like env-step sums), not byte counts.
+        nbytes = (
+            int(ret)
+            if item.cls == INGEST and item.nbytes == 0
+            and isinstance(ret, (int, float)) and not isinstance(ret, bool)
+            else item.nbytes
+        )
+        self.stats.record_dispatch(item.cls, nbytes, time.perf_counter() - t0)
+        self._charge(item.cls, nbytes)
+        item.ticket._finish(result=ret)
+
+    def _on_thread_death(self, exc: BaseException, item: Optional[_Item]) -> None:
+        """The scheduler loop itself died (injected fault or a bug in the
+        pick/wait machinery — every such crash point sits BEFORE the
+        item's callable runs; _dispatch catches around the callable, and
+        a completed item is nulled before the next pick). Within the
+        restart budget the crash is therefore transparent to submitters:
+        the in-flight item goes back to the head of its queue and the
+        thread restarts. Past the cap the failure is structural and every
+        waiter must see it."""
+        if self.restarts < self._max_restarts and not self._stop:
+            self.restarts += 1
+            trace.instant("transfer_restart", n=self.restarts)
+            print(
+                f"[transfer] scheduler thread died ({exc!r}); restarting "
+                f"({self.restarts}/{self._max_restarts})",
+                file=sys.stderr, flush=True,
+            )
+            with self._cv:
+                if item is not None and not item.ticket.done():
+                    self._queues[item.cls].appendleft(item)
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="transfer-sched"
+            )
+            self._thread.start()
+            return
+        if item is not None and not item.ticket.done():
+            item.ticket._finish(exc=exc)
+        with self._cv:
+            self._dead_exc = exc
+        self._fail_pending(
+            TransferError("transfer scheduler thread died"), cause=exc
+        )
+
+    def _fail_pending(self, err: TransferError, cause=None) -> None:
+        if cause is not None:
+            err.__cause__ = cause
+        with self._cv:
+            items = [i for q in self._queues.values() for i in q]
+            for q in self._queues.values():
+                q.clear()
+        for i in items:
+            if not i.ticket.done():
+                i.ticket._finish(exc=err)

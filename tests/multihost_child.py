@@ -73,6 +73,8 @@ def main() -> None:
         run_replay_parity(pid, nprocs, tag=f"proc{pid}")
     elif mode == "coalesce":
         run_coalesced_ingest_parity(pid, tag=f"proc{pid}")
+    elif mode == "bgsync":
+        run_background_sync_ship_parity(pid, tag=f"proc{pid}")
     elif mode == "train":
         run_train_parity(tag=f"proc{pid}")
     elif mode == "fused":
@@ -178,6 +180,73 @@ def run_coalesced_ingest_parity(pid: int, tag: str) -> None:
         and int(jax.device_get(serial.ptr)) == int(jax.device_get(coal.ptr))
         and int(jax.device_get(serial.size)) == int(jax.device_get(coal.size))
     )
+    checksum = float(np.abs(s1).sum())
+    print(f"PARITY {tag} {int(identical)} {checksum:.4f}", flush=True)
+
+
+def run_background_sync_ship_parity(pid: int, tag: str) -> None:
+    """Background lockstep sync_ship (docs/TRANSFER.md) vs the synchronous
+    reference IN THE SAME CLUSTER: `serial` ships with blocking learner-
+    thread collectives (the PR-1 path), `bg` issues beats on the transfer
+    scheduler's lockstep lane (sync_ship_begin, counts snapshot at token
+    time) and only waits tickets at the gate points. Storage/ptr/size
+    must come out bit-identical, and the replicas must agree. Per-process
+    program order keeps the collective schedule consistent: every serial
+    collective completes before any bg beat is issued."""
+    import numpy as np
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.transfer import TransferScheduler
+
+    obs_dim, act_dim = 5, 2
+    config = DDPGConfig(
+        actor_hidden=(16, 16), critic_hidden=(16, 16), batch_size=16, seed=0
+    )
+    learner = ShardedLearner(config, obs_dim, act_dim, action_scale=1.0,
+                             chunk_size=2)
+    serial = DeviceReplay(8192, obs_dim, act_dim, mesh=learner.mesh,
+                          block_size=128, max_coalesce=4)
+    sched = TransferScheduler().start()
+    bg = DeviceReplay(8192, obs_dim, act_dim, mesh=learner.mesh,
+                      block_size=128, max_coalesce=4,
+                      scheduler=sched, background_sync=True)
+    assert bg._bg_sync, "background beats must arm on a multi-process mesh"
+    r = np.random.default_rng(70 + pid)
+    rows = (0.1 * r.standard_normal((5 * 128 + 37, serial.width))).astype(
+        np.float32
+    )
+    # Reference: synchronous beats, two waves + a force pad.
+    serial.add_packed(rows[:300].copy())
+    serial.sync_ship()
+    serial.add_packed(rows[300:].copy())
+    serial.sync_ship()
+    serial.sync_ship(force=True)
+    # Background: identical wave structure, beats issued WITHOUT waiting
+    # (t1 resolves only after t2 was issued — genuinely overlapped), the
+    # force beat routed synchronously through the same lane.
+    bg.add_packed(rows[:300].copy())
+    t1 = bg.sync_ship_begin()
+    bg.add_packed(rows[300:].copy())
+    t2 = bg.sync_ship_begin()
+    moved1 = t1.result(timeout=240)
+    moved2 = t2.result(timeout=240)
+    moved3 = bg.sync_ship(force=True)
+    assert moved1 + moved2 + moved3 == len(rows), (moved1, moved2, moved3)
+
+    import jax
+
+    s0 = np.asarray(jax.device_get(serial.storage))
+    s1 = np.asarray(jax.device_get(bg.storage))
+    identical = bool(
+        np.array_equal(s0, s1)
+        and int(jax.device_get(serial.ptr)) == int(jax.device_get(bg.ptr))
+        and int(jax.device_get(serial.size)) == int(jax.device_get(bg.size))
+    )
+    snap = sched.snapshot()
+    assert snap["transfer_lockstep_items"] == 3, snap
+    sched.close()
     checksum = float(np.abs(s1).sum())
     print(f"PARITY {tag} {int(identical)} {checksum:.4f}", flush=True)
 

@@ -328,11 +328,97 @@ def test_monitor_backoff_then_quarantine(monkeypatch):
     assert pool._quarantined[0]
     assert pool.recovery_counters() == {
         "actor_respawns": 2, "actor_quarantined": 1,
+        "actor_unquarantined": 0,
     }
-    # Quarantined slots are never touched again.
+    # Quarantined slots are never touched before the probe cooldown
+    # (default quarantine_probe_s is minutes; this test never reaches it).
     time.sleep(0.25)
     assert pool.monitor()["respawned"] == 0
     assert spawned == [0, 0]
+
+
+def test_monitor_quarantine_probe_recovers_slot(monkeypatch):
+    """Quarantine probing (docs/RESILIENCE.md): after quarantine_probe_s
+    the monitor probes the slot with ONE respawn; sustained progress
+    (rows + surviving quarantine_window_s) un-quarantines it and the
+    actor_unquarantined counter rides recovery_counters."""
+    pool, spawned = _stub_pool(
+        monkeypatch,
+        respawn_backoff_s=0.0, quarantine_respawns=2,
+        quarantine_window_s=0.05, quarantine_probe_s=0.1,
+    )
+    # Two immediate failures -> quarantine.
+    pool.monitor()
+    pool.monitor()
+    pool.monitor()
+    assert pool.quarantined_count == 1
+    n_before_probe = len(spawned)
+    # Before the cooldown: untouched.
+    assert pool.monitor()["respawned"] == 0
+    time.sleep(0.12)
+    stats = pool.monitor()  # cooldown elapsed -> probe respawn
+    assert stats["respawned"] == 1
+    assert len(spawned) == n_before_probe + 1
+    assert not pool._quarantined[0] and pool._probing[0]
+    # Probe succeeds: worker alive, heartbeating, delivering rows.
+    pool._procs[0] = _FakeProc()
+    pool._heartbeat[0] = time.time()
+    pool._note_version(0, 0)          # rows drained from the probed slot
+    time.sleep(0.06)                  # survive quarantine_window_s
+    pool._heartbeat[0] = time.time()
+    pool.monitor()
+    assert not pool._probing[0] and pool.quarantined_count == 0
+    assert pool.recovery_counters()["actor_unquarantined"] == 1
+
+
+def test_monitor_probe_heartbeats_without_rows_is_not_progress(monkeypatch):
+    """The zero-rows detector ARMS _last_rows_t at the first heartbeat;
+    that arming write must not satisfy the probe's sustained-progress
+    check — a heartbeating-but-rowless probe is not a recovery."""
+    pool, _ = _stub_pool(
+        monkeypatch,
+        respawn_backoff_s=0.0, quarantine_respawns=2,
+        quarantine_window_s=0.05, quarantine_probe_s=0.1,
+        actor_no_progress_s=10.0,  # detector armed, far from firing
+    )
+    pool.monitor()
+    pool.monitor()
+    pool.monitor()
+    assert pool.quarantined_count == 1
+    time.sleep(0.12)
+    pool.monitor()                    # probe respawn
+    assert pool._probing[0]
+    pool._procs[0] = _FakeProc()
+    pool._heartbeat[0] = time.time()
+    pool.monitor()                    # arms the zero-rows clock, NO rows
+    time.sleep(0.06)                  # past quarantine_window_s
+    pool._heartbeat[0] = time.time()
+    pool.monitor()
+    assert pool._probing[0], "rowless heartbeats must not end the probe"
+    assert pool.recovery_counters()["actor_unquarantined"] == 0
+
+
+def test_monitor_quarantine_probe_failure_requarantines(monkeypatch):
+    """A failed probe goes STRAIGHT back to quarantine for another
+    cooldown — no backoff/breaker loop, no respawn stampede."""
+    pool, spawned = _stub_pool(
+        monkeypatch,
+        respawn_backoff_s=0.0, quarantine_respawns=2,
+        quarantine_window_s=0.05, quarantine_probe_s=0.1,
+    )
+    pool.monitor()
+    pool.monitor()
+    pool.monitor()
+    assert pool.quarantined_count == 1
+    time.sleep(0.12)
+    pool.monitor()                    # probe respawn (stub leaves it dead)
+    assert pool._probing[0]
+    pool.monitor()                    # dead probe detected
+    assert pool.quarantined_count == 1 and not pool._probing[0]
+    assert pool.recovery_counters()["actor_unquarantined"] == 0
+    n = len(spawned)
+    pool.monitor()                    # cooldown restarted: no respawn yet
+    assert len(spawned) == n
 
 
 def test_monitor_zero_rows_blind_spot(monkeypatch):
@@ -462,7 +548,10 @@ def test_prefetch_stop_during_sampler_hang_leaks_loudly():
 
 
 def test_prefetch_sampler_crash_surfaces_in_next():
-    from distributed_ddpg_tpu.parallel.prefetch import ChunkPrefetcher
+    from distributed_ddpg_tpu.parallel.prefetch import (
+        ChunkPrefetcher,
+        PrefetchTimeout,
+    )
 
     site = FaultPlan.parse("prefetch:sample:crash@1").site(
         "prefetch", "sample"
@@ -472,9 +561,16 @@ def test_prefetch_sampler_crash_surfaces_in_next():
     ).start()
     try:
         with pytest.raises(RuntimeError, match="prefetch thread died") as ei:
-            deadline = time.monotonic() + 5
+            deadline = time.monotonic() + 10
             while time.monotonic() < deadline:
-                pf.next(timeout=0.5)
+                try:
+                    pf.next(timeout=0.5)
+                except PrefetchTimeout:
+                    # PrefetchTimeout IS a RuntimeError: a slow worker
+                    # start under load must not satisfy the raises()
+                    # with the wrong exception — keep polling until the
+                    # crash itself surfaces.
+                    continue
         assert isinstance(ei.value.__cause__, InjectedFault)
     finally:
         pf.stop()

@@ -148,6 +148,128 @@ def test_per_coalesced_async_parity_with_serial():
         asy.close()
 
 
+def test_scheduler_adaptive_pool_parity_with_serial():
+    """The full transfer-scheduler ingest path (scheduled work items +
+    adaptive coalesce cap + pooled host buffers, docs/TRANSFER.md) must
+    leave storage/ptr/size bit-identical to the seed's serial sequence —
+    the adaptive cap only changes WHEN rows land, never WHERE."""
+    from distributed_ddpg_tpu.transfer import TransferScheduler
+
+    serial = _mk(max_coalesce=1)
+    sched = TransferScheduler().start()
+    try:
+        via = _mk(
+            async_ship=True, max_coalesce=8, staging_blocks=4,
+            scheduler=sched, adaptive_coalesce=True, host_pool=True,
+        )
+        assert via._shipper is None, "scheduler path must not spawn a thread"
+        for b in _inflow(seed=7):
+            serial.add_packed(b)
+            via.add_packed(b)
+        via.drain_pending()
+        assert serial.pending_rows == via.pending_rows
+        serial.flush()
+        via.flush()
+        s0, p0, n0 = _snap(serial)
+        s1, p1, n1 = _snap(via)
+        assert (p0, n0) == (p1, n1)
+        np.testing.assert_array_equal(s0, s1)
+        snap = sched.snapshot()
+        assert snap["transfer_ingest_items"] >= 1
+        assert 1 <= via.transfer_snapshot()["transfer_coalesce_cap"] <= 8
+        via.close()
+    finally:
+        sched.close()
+
+
+def test_adaptive_cap_jitter_keeps_parity():
+    """Adversarial adaptive-cap schedule: force the effective cap through
+    an arbitrary trajectory mid-stream and assert bit-identity anyway —
+    the structural guarantee the adaptive controller leans on."""
+    class _JitterCap:
+        def __init__(self):
+            self.seq = [1, 4, 2, 8, 1, 2, 4, 8]
+            self.i = 0
+
+        def cap(self):
+            self.i += 1
+            return self.seq[self.i % len(self.seq)]
+
+        def observe_ship(self, blocks, ship_s, queue_rows):
+            pass
+
+        def snapshot(self):
+            return {}
+
+    serial = _mk(max_coalesce=1)
+    jit = _mk(max_coalesce=8)
+    jit._adaptive = _JitterCap()
+    for b in _inflow(seed=8):
+        serial.add_packed(b)
+        jit.add_packed(b)
+    serial.flush()
+    jit.flush()
+    s0, p0, n0 = _snap(serial)
+    s1, p1, n1 = _snap(jit)
+    assert (p0, n0) == (p1, n1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_per_scheduler_parity_with_serial():
+    """PER through the scheduler path: priority stamps must equal the
+    serial sequence's too (same max_priority, same index ranges)."""
+    from distributed_ddpg_tpu.transfer import TransferScheduler
+
+    serial = _mk(DevicePrioritizedReplay, max_coalesce=1)
+    sched = TransferScheduler().start()
+    try:
+        via = _mk(
+            DevicePrioritizedReplay, async_ship=True, max_coalesce=8,
+            scheduler=sched, adaptive_coalesce=True, host_pool=True,
+        )
+        for b in _inflow(seed=9):
+            serial.add_packed(b)
+            via.add_packed(b)
+        via.drain_pending()
+        serial.flush()
+        via.flush()
+        s0, p0, n0 = _snap(serial)
+        s1, p1, n1 = _snap(via)
+        assert (p0, n0) == (p1, n1)
+        np.testing.assert_array_equal(s0, s1)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(serial.priorities)),
+            np.asarray(jax.device_get(via.priorities)),
+        )
+        via.close()
+    finally:
+        sched.close()
+
+
+def test_scheduler_ingest_failure_bounded_restart():
+    """A failing ingest work item recovers through the same bounded
+    budget as a dying _IngestShipper thread, then surfaces IngestError."""
+    from distributed_ddpg_tpu.transfer import TransferScheduler
+
+    class Boom(DeviceReplay):
+        def _ship(self, chunk):
+            raise RuntimeError("boom h2d")
+
+    sched = TransferScheduler().start()
+    try:
+        rep = _mk(Boom, async_ship=True, scheduler=sched)
+        rows = np.zeros((64, W), np.float32)
+        with pytest.raises(IngestError, match="shipper thread died"):
+            for _ in range(300):
+                rep.add_packed(rows)
+                time.sleep(0.01)
+            pytest.fail("scheduler-path ingest death never surfaced")
+        assert rep.ingest_snapshot()["ingest_shipper_restarts"] == 3
+        rep.close()
+    finally:
+        sched.close()
+
+
 def test_reward_sample_includes_staged_rows():
     rep = _mk()
     rows = np.zeros((30, W), np.float32)
@@ -293,3 +415,22 @@ def test_bench_ingest_smoke(monkeypatch):
     assert fields["rate"] > 0
     assert fields["ingest_ship_calls"] >= 1
     assert fields["t_dispatch_p95"] >= 0
+    # Transfer-scheduler smoke (docs/TRANSFER.md): the bench runs the
+    # production scheduler path by default; its snapshot must be present
+    # and self-consistent (the CI gate pins transfer_ingest_p95).
+    transfer = out["transfer_bench"]
+    for key in (
+        "transfer_dispatches", "transfer_ingest_items",
+        "transfer_ingest_bytes", "transfer_ingest_ms",
+        "transfer_ingest_p95", "transfer_coalesce_cap",
+        "transfer_coalesce_grows", "transfer_restarts",
+    ):
+        assert key in transfer, key
+    assert transfer["transfer_ingest_items"] >= 1
+    assert transfer["transfer_ingest_bytes"] > 0
+    assert transfer["transfer_dispatches"] == (
+        transfer["transfer_ingest_items"]
+        + transfer["transfer_prefetch_items"]
+        + transfer["transfer_lockstep_items"]
+    )
+    assert transfer["transfer_restarts"] == 0

@@ -122,6 +122,20 @@ def test_two_process_coalesced_ingest_parity():
 
 
 @pytest.mark.slow
+def test_two_process_background_sync_ship_parity():
+    """Background lockstep sync_ship (docs/TRANSFER.md): beats issued on
+    the transfer scheduler's ordered lane (counts snapshot at token time,
+    learner-side waits only at the gates) must land storage bit-identical
+    to the synchronous learner-thread collectives — and the replicas must
+    agree. This is the acceptance check for moving the DCN ingest
+    collective off the learner thread without breaking lockstep."""
+    (_, ok0, ck0), (_, ok1, ck1) = _run_pair("bgsync")
+    assert ok0 == "1", "background storage != synchronous storage on proc0"
+    assert ok1 == "1", "background storage != synchronous storage on proc1"
+    assert ck0 == ck1, f"replica checksum fork: {ck0} vs {ck1}"
+
+
+@pytest.mark.slow
 def test_two_process_fused_mesh_parity():
     """Megakernel x mesh (fused_mesh, K-step local SGD) on a {data:4} mesh
     spanning 2 processes: the chunk-boundary param pmean is a CROSS-PROCESS

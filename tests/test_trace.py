@@ -234,10 +234,14 @@ def test_train_jax_traced_run_multithread_timeline(tmp_path):
     assert len(span_threads) >= 3, (
         f"expected spans from >=3 threads, got {sorted(span_threads)}"
     )
-    assert "ingest-ship" in span_threads, sorted(span_threads)
+    # Ingest dispatch runs on the unified transfer scheduler's thread by
+    # default (docs/TRANSFER.md); transfer_scheduler=False falls back to
+    # the PR-1 private shipper thread.
+    assert "transfer-sched" in span_threads, sorted(span_threads)
     span_names = {e["name"] for e in spans}
     assert "dispatch" in span_names       # learner phase bracket
-    assert "ingest_ship" in span_names    # shipper thread
+    assert "ingest_ship" in span_names    # scheduled ingest work item
+    assert "transfer_ingest" in span_names  # the scheduler's class span
     assert "eval_rollout" in span_names   # eval worker thread
 
     train_recs = [
