@@ -672,6 +672,16 @@ def main() -> int:
         note(f"probe failed: {str(err)[:200]}")
         if attempt < 2:
             time.sleep(5 * (attempt + 1))
+    if probe is None and accel_errors:
+        # Structured probe-failure record: everything measured below is a
+        # CPU fallback, and a fallback number must never become a CI
+        # baseline — scripts/ci_gate.sh skips any BENCH JSON carrying
+        # probe_error during baseline auto-selection, instead of a human
+        # having to know which BENCH_r* was the last healthy capture.
+        result["probe_error"] = {
+            "attempts": len(accel_errors),
+            "last": str(accel_errors[-1])[:500],
+        }
     if probe and study_only:
         result["platform"] = probe["platform"]
         result["device_kind"] = probe["device_kind"]

@@ -87,6 +87,44 @@ def _snapshot(
     return ckpt
 
 
+def _checkpointer() -> "ocp.StandardCheckpointer":
+    """A StandardCheckpointer whose cross-process barriers are scoped to
+    THIS process only. The repo's checkpoint discipline is single-writer
+    (train.py: process 0 writes the replicated state; pod aborts add
+    per-process emergency dirs — docs/RESILIENCE.md pod rows), so
+    orbax's default all-process barrier is wrong twice over: a lone
+    writer's `sync_global_devices` is a COLLECTIVE the other processes
+    never join, which both wedges the save and interleaves a mismatched
+    op into the training pod's lockstep gloo streams (observed as
+    `gloo EnforceNotMet op.preamble.length <= op.nbytes` corruption on
+    the 3-process chaos harness); and at pod-abort time an all-process
+    barrier can never complete — the dead peer is exactly why we are
+    checkpointing. Subset barriers (active_processes = {this process})
+    keep orbax's atomic-rename machinery intact with zero cross-process
+    traffic. Single-process runs keep stock options (every barrier is
+    already skipped)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    me = jax.process_index()
+    mp = ocp.options.MultiprocessingOptions(
+        primary_host=me,
+        active_processes={me},
+        barrier_sync_key_prefix=f"proc{me}",
+    )
+    # use_ocdbt=False: OCDBT's per-process write + merge machinery also
+    # assumes an all-process save (the merge validated a partial world
+    # and rejected single-writer saves with "params missing"); the
+    # classic per-param layout has no cross-process step at all.
+    return ocp.Checkpointer(
+        ocp.PyTreeCheckpointHandler(
+            use_ocdbt=False, multiprocessing_options=mp
+        ),
+        multiprocessing_options=mp,
+    )
+
+
 # Checkpoints RETAINED after each successful write (latest N). A
 # checkpoint with a full 1M-row replay is ~3 GB; without retention a
 # 2M-step Humanoid run at checkpoint_every=10k writes ~200 of them
@@ -239,7 +277,7 @@ def _write_once(directory: str, step: int, ckpt: Dict[str, Any],
     # step, so clear it.
     if os.path.isdir(path):
         shutil.rmtree(path, ignore_errors=True)
-    with ocp.StandardCheckpointer() as ckptr:
+    with _checkpointer() as ckptr:
         ckptr.save(path, ckpt)
     if config is not None:
         # nan (the v_min/v_max auto sentinel) would serialize as the
@@ -448,6 +486,20 @@ def latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def valid_steps(directory: str, limit: Optional[int] = None):
+    """Manifest-valid retained steps, ascending (verify_checkpoint passes
+    — pre-manifest checkpoints count as valid, matching restore()'s
+    fallback semantics). The input to the pod resume-step election
+    (parallel/multihost.elect_resume_step): a pod restarting after a
+    clean abort restores the greatest step valid on EVERY process, so
+    per-process step lists must be cheap and honest. `limit` keeps only
+    the newest N."""
+    if not directory or not os.path.isdir(directory):
+        return []
+    out = [s for s in _steps(directory) if verify_checkpoint(directory, s)[0]]
+    return out[-limit:] if limit else out
+
+
 def restore(
     directory: str,
     state_template: TrainState,
@@ -522,7 +574,7 @@ def restore(
     }
     if replay is not None:
         template["replay"] = replay.state_dict()
-    with ocp.StandardCheckpointer() as ckptr:
+    with _checkpointer() as ckptr:
         # Checkpoints written before the 'meta' entry existed lack that
         # subtree, and orbax requires the template to match the on-disk tree
         # exactly. Probe the saved structure rather than catching ValueError,

@@ -329,6 +329,26 @@ class DDPGConfig:
     # up to this many times with exponential backoff before surfacing.
     ckpt_write_retries: int = 2
     ckpt_retry_backoff_s: float = 0.5
+    # --- pod resilience (parallel/multihost.py; docs/RESILIENCE.md) ---
+    # Deadline on every host-initiated DCN collective (sync_ship beats,
+    # the env-budget all-gather, the scheduler's lockstep lane): a
+    # collective whose peer died surfaces as a typed PodPeerLost within
+    # this many seconds — coordinated clean abort, emergency checkpoint,
+    # exit EXIT_POD_DEGRADED (76) — instead of blocking the pod forever.
+    # Armed only on multi-process runs (single-process collectives
+    # short-circuit, zero overhead); known-long windows (first-chunk XLA
+    # compile, support expansion) get the same grant the stall watchdog
+    # gets, so compile skew between processes is not read as peer death.
+    # Keep it well under watchdog_s where both are armed — peer loss
+    # should exit 76 (resumable pod abort), not 70 (wedged device).
+    # 0 = off (the pre-PR-6 block-forever behavior).
+    pod_collective_timeout_s: float = 60.0
+    # One-time startup rendezvous grace (multihost.startup_barrier),
+    # deliberately much larger than the steady-state deadline: backend
+    # init / import skew under host load is absorbed once at startup
+    # instead of false-firing the per-beat deadline (the documented gloo
+    # child startup flake, CHANGES.md PR 5).
+    pod_startup_grace_s: float = 300.0
 
     def replace(self, **kwargs) -> "DDPGConfig":
         return dataclasses.replace(self, **kwargs)
@@ -570,6 +590,10 @@ class DDPGConfig:
             raise ValueError("ckpt_write_retries must be >= 0")
         if self.ckpt_retry_backoff_s < 0:
             raise ValueError("ckpt_retry_backoff_s must be >= 0")
+        if self.pod_collective_timeout_s < 0:
+            raise ValueError("pod_collective_timeout_s must be >= 0 (0 = off)")
+        if self.pod_startup_grace_s < 0:
+            raise ValueError("pod_startup_grace_s must be >= 0")
         if self.trace_events < 16:
             raise ValueError("trace_events must be >= 16")
         if self.transport not in ("auto", "shm", "queue"):

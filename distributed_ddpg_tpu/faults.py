@@ -17,15 +17,22 @@ yields the same schedule.
 Grammar (';'-separated specs):
 
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
-    component := worker | pool | shipper | prefetch | ckpt
-    kind      := crash | crashloop | hang | stall | slow | ioerror
+    component := worker | pool | shipper | prefetch | ckpt | transfer | pod
+    kind      := crash | crashloop | hang | stall | slow | ioerror | kill
 
 `at` is 1-based: for `worker` it is the env step inside that worker's
 FIRST incarnation (a respawned worker gets a clean slate — except
 `crashloop`, which re-arms on every incarnation to drive the pool's
 crash-loop circuit breaker); for host-side sites it is the n-th call to
-the instrumented operation. `~seconds` sets the duration of `slow`/`hang`
-faults (default: seeded draw, see `_default_duration`).
+the instrumented operation; for `pod` it is the n-th STEADY-STATE
+lockstep sync_ship beat that process issues (replay/device.py
+_sync_ship_collective, armed by train_jax at the warmup/steady boundary
+— one beat per learner chunk, the same ordinal on every process since
+beats are lockstep; warmup beats don't count, their number is
+wall-clock-paced by actor startup). `~seconds`
+sets the duration of `slow`/`hang` faults (default: seeded draw, see
+`_default_duration`; pod hangs default LONG — they exist to outlast the
+pod collective deadline, not a host-site timeout).
 
 Fault semantics by component:
 
@@ -46,6 +53,14 @@ Fault semantics by component:
                              killing the scheduler THREAD (its bounded
                              self-restart path — transfer/scheduler.py)
     transfer:dispatch:slow@K~S K-th transfer dispatch sleeps S first
+    pod:<proc>:kill@K        process <proc> SIGKILLs itself at its K-th
+                             lockstep sync_ship beat — real process death
+                             mid-collective; survivors must surface it as
+                             PodPeerLost via the collective deadline
+                             (parallel/multihost.py, docs/RESILIENCE.md)
+    pod:<proc>:hang@K~S      process <proc> freezes S seconds (default:
+                             effectively forever) at its K-th beat — the
+                             hung-peer flavor of the same contract
 
 The legacy one-shot hook `--inject_fault=actor:<id>:<step>` is accepted as
 an alias for `worker:<id>:crash@<step>`.
@@ -60,22 +75,27 @@ attribute check — safe to leave on every production call site.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
+import signal
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer")
-KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror")
+COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
+              "pod")
+KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill")
 
 # Worker `slow` faults throttle this many consecutive env steps, then lift
 # — bounded so a chaos soak keeps making progress past the fault.
 SLOW_FAULT_STEPS = 200
 
 # Worker-only kinds need a process to kill/freeze; site-only kinds need a
-# call site that can raise/sleep inline.
+# call site that can raise/sleep inline; pod kinds target a whole PROCESS
+# of a multi-host pod at a lockstep-beat ordinal (docs/RESILIENCE.md).
 _WORKER_KINDS = ("crash", "crashloop", "hang", "stall", "slow")
 _SITE_KINDS = ("crash", "hang", "slow", "ioerror")
+_POD_KINDS = ("kill", "hang")
 
 
 class InjectedFault(OSError):
@@ -97,13 +117,19 @@ class FaultSpec:
         return f"{self.component}{tgt}:{self.kind}@{self.at}"
 
 
-def _default_duration(kind: str, rng: random.Random) -> float:
+def _default_duration(kind: str, rng: random.Random,
+                      component: str = "") -> float:
     """Seeded default durations: slowdowns are sub-second hiccups, hangs
     are long enough to trip the timeouts they target (worker hangs ignore
-    this — they freeze until terminated)."""
+    this — they freeze until terminated). A pod hang defaults to
+    effectively-forever: its job is to outlast the pod collective
+    deadline so survivors prove the PodPeerLost path, not to clear a
+    host-site timeout."""
     if kind == "slow":
         return round(rng.uniform(0.05, 0.25), 3)
     if kind == "hang":
+        if component == "pod":
+            return 3600.0
         return round(rng.uniform(2.0, 5.0), 3)
     return 0.0
 
@@ -163,6 +189,13 @@ class FaultPlan:
         ]
         return FaultSite(matches, component, target)
 
+    def pod_site(self, process_index: int) -> "FaultSite":
+        """The pod-scoped injector for ONE process of a multi-host run:
+        only specs targeting `process_index` fire; every process still
+        ticks the site once per lockstep beat so ordinals stay aligned
+        with the (identical-everywhere) beat sequence."""
+        return self.site("pod", str(int(process_index)))
+
 
 def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
     def bad(why: str) -> ValueError:
@@ -218,11 +251,20 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
             int(target)
         except ValueError:
             raise bad("worker target must be an integer id") from None
+    elif component == "pod":
+        if kind not in _POD_KINDS:
+            raise bad(
+                f"kind {kind!r} does not apply to pod (one of {_POD_KINDS})"
+            )
+        try:
+            int(target)
+        except ValueError:
+            raise bad("pod target must be an integer process id") from None
     else:
         if kind not in _SITE_KINDS:
-            raise bad(f"kind {kind!r} only applies to workers")
+            raise bad(f"kind {kind!r} does not apply to host sites")
     if duration is None:
-        duration = _default_duration(kind, rng)
+        duration = _default_duration(kind, rng, component)
     return FaultSpec(component, target, kind, at, duration)
 
 
@@ -259,6 +301,13 @@ class FaultSite:
             self.fired.append(s.describe())
             if s.kind in ("slow", "hang"):
                 time.sleep(s.duration_s)
+            elif s.kind == "kill":
+                # Pod-scoped process death (pod:<proc>:kill@beat): SIGKILL
+                # ourselves — no cleanup, no exception, exactly the shape
+                # of a real preemption. Survivors must detect the loss
+                # through the collective deadline (PodPeerLost), not
+                # through any in-process signal.
+                os.kill(os.getpid(), signal.SIGKILL)
             else:  # ioerror / crash
                 raise InjectedFault(
                     f"injected {s.describe()} (call #{self._count})"

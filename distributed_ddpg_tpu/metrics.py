@@ -359,6 +359,85 @@ class TransferStats:
         return out
 
 
+class PodStats:
+    """Thread-safe pod-resilience counters (parallel/multihost.py;
+    docs/RESILIENCE.md pod rows) — the `pod_*` family every train/final
+    JSONL record carries on multi-process runs. Counters are CUMULATIVE
+    (peer loss and aborts are rare, terminal events; interval-resetting
+    them would hide the one record that matters):
+
+      pod_peer_lost               collectives declared lost (deadline
+                                  timeout or mid-flight transport error)
+      pod_aborts                  coordinated clean aborts taken (the
+                                  EXIT_POD_DEGRADED path)
+      pod_resume_step_elected     the step the coordinated resume election
+                                  agreed on (-1 = no election ran / no
+                                  common step)
+      pod_beats                   heartbeat-bearing lockstep beats gathered
+      pod_collective_near_misses  guarded collectives that consumed > 80%
+                                  of their deadline (the tune-the-timeout
+                                  signal BEFORE a false PodPeerLost)
+      pod_collective_slack_p95_ms deadline headroom at the p95-slowest
+                                  collective (deadline - p95 elapsed);
+                                  trending toward 0 = deadline too tight
+    """
+
+    NEAR_MISS_FRAC = 0.8
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.peer_lost = 0
+        self.aborts = 0
+        self.resume_step_elected = -1
+        self.beats = 0
+        self.near_misses = 0
+        self._deadline_s = 0.0
+        self._elapsed = _Reservoir(
+            64, (zlib.crc32(b"pod_collective") ^ seed) & 0x7FFFFFFF
+        )
+
+    def record_collective(self, elapsed_s: float, deadline_s: float) -> None:
+        with self._lock:
+            self._deadline_s = deadline_s
+            self._elapsed.add(elapsed_s)
+            if elapsed_s > self.NEAR_MISS_FRAC * deadline_s:
+                self.near_misses += 1
+
+    def record_peer_lost(self) -> None:
+        with self._lock:
+            self.peer_lost += 1
+
+    def record_abort(self) -> None:
+        with self._lock:
+            self.aborts += 1
+
+    def record_resume_elected(self, step: int) -> None:
+        with self._lock:
+            self.resume_step_elected = int(step)
+
+    def note_beat(self) -> None:
+        with self._lock:
+            self.beats += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            slack_ms = 0.0
+            if self._elapsed.buf and self._deadline_s > 0:
+                slack_ms = round(
+                    1000.0
+                    * (self._deadline_s - self._elapsed.percentile(0.95)),
+                    3,
+                )
+            return {
+                "pod_peer_lost": self.peer_lost,
+                "pod_aborts": self.aborts,
+                "pod_resume_step_elected": self.resume_step_elected,
+                "pod_beats": self.beats,
+                "pod_collective_near_misses": self.near_misses,
+                "pod_collective_slack_p95_ms": slack_ms,
+            }
+
+
 class Timer:
     """Running steps/sec meter for the actor/learner rate metrics.
     Monotonic clock: a wall-clock jump (NTP step, manual date set) on a

@@ -131,6 +131,7 @@ class DeviceReplay:
         adaptive_coalesce: bool = False,
         host_pool: bool = False,
         background_sync: bool = False,
+        pod_fault=None,
     ):
         self.capacity = int(capacity)
         self.obs_dim = obs_dim
@@ -169,6 +170,14 @@ class DeviceReplay:
         # raises (killing the shipper thread, which _check_shipper then
         # restarts — the supervised-recovery path under test).
         self._fault = fault
+        # Pod chaos site (faults.py pod:<proc>:kill|hang@beat): ticked once
+        # per lockstep sync_ship beat, so the beat ordinal is the trigger —
+        # identical on every process, which is what lets a scripted
+        # single-process death land at a deterministic pod-wide point.
+        # train_jax arms it via arm_pod_fault at the first POST-WARMUP
+        # beat: warmup's beat count is wall-clock-dependent (actor startup
+        # pacing), steady-state beats advance one per lockstep chunk.
+        self._pod_fault = pod_fault
         self._shipper_restarts = 0
         self._max_shipper_restarts = 3
 
@@ -320,6 +329,12 @@ class DeviceReplay:
         if self._pool is not None:
             out.update(self._pool.snapshot())
         return out
+
+    def arm_pod_fault(self, site) -> None:
+        """Attach the pod chaos site (see __init__). Armed late so the
+        trigger ordinal counts beats from a deterministic point (the
+        warmup/steady boundary is lockstep on every process)."""
+        self._pod_fault = site
 
     def close(self) -> None:
         """Stop the background shipper (if any) and detach from the
@@ -656,15 +671,23 @@ class DeviceReplay:
         # stable against rows the producer stages concurrently — those
         # belong to a later beat.
         count = self.pending_rows
-        from distributed_ddpg_tpu.parallel.multihost import allgather_scalar
+        from distributed_ddpg_tpu.parallel import multihost
 
+        # Pod chaos trigger: the beat ordinal (see __init__). Fires
+        # BEFORE the collective, so a kill/hang leaves the peers blocked
+        # inside THIS beat's all-gather — the exact failure the pod
+        # collective deadline (docs/RESILIENCE.md) exists to surface.
+        if self._pod_fault is not None:
+            self._pod_fault.tick()
         # One span over the whole lockstep beat (count all-gather +
         # ships): on the timeline this is the calling thread blocked on
         # the DCN collective — in background mode the span lands on the
         # transfer-sched track, overlapping the learner's chunk compute
         # (the overlap the ROADMAP lockstep-token item asked for).
+        # beat_allgather piggybacks the pod heartbeat word on the count
+        # payload (parallel/multihost.py peer-liveness tracking).
         with trace.span("sync_ship", beat=self._beat):
-            counts = allgather_scalar(np.int32(count))
+            counts = multihost.beat_allgather(count)
             m = int(counts.min())
             moved = 0
             cap_blocks = self.capacity // (self._procs * self.block_size)

@@ -163,6 +163,19 @@ RECOVERY_KEYS = (
     "transfer_restarts",
 )
 
+# Pod-resilience counters (metrics.PodStats; docs/RESILIENCE.md pod rows)
+# — present only on multi-process runs. Cumulative/gauge semantics, so the
+# digest reports the LAST value; slack is the tune-the-deadline telemetry
+# (trending toward 0 = pod_collective_timeout_s too tight).
+POD_KEYS = (
+    "pod_peer_lost",
+    "pod_aborts",
+    "pod_resume_step_elected",
+    "pod_beats",
+    "pod_collective_near_misses",
+    "pod_collective_slack_p95_ms",
+)
+
 
 def summarize_run(path: str) -> Dict[str, Any]:
     """Machine-readable digest of one JSONL run (the CLI renders it; tests
@@ -234,6 +247,15 @@ def summarize_run(path: str) -> Dict[str, Any]:
             transfer[key] = {"steady": _tail_mean(vals), "max": max(vals)}
     digest["transfer"] = transfer
 
+    # Pod digest (multi-process runs only): last value of each pod_*
+    # counter/gauge across train+final records.
+    pod = {}
+    for key in POD_KEYS:
+        vals = _col(train + kinds.get("final", []), key)
+        if vals:
+            pod[key] = {"last": vals[-1], "max": max(vals)}
+    digest["pod"] = pod
+
     recovery = {}
     for key in RECOVERY_KEYS:
         vals = _col(train + final, key)
@@ -299,6 +321,13 @@ def render_summary(digest: Dict[str, Any]) -> str:
                 [k, v["steady"], v["max"]]
                 for k, v in digest["transfer"].items()
             ],
+        ))
+    if digest.get("pod"):
+        pod = digest["pod"]
+        out.append("\n-- pod resilience (docs/RESILIENCE.md pod rows)")
+        out.append(render_table(
+            ["field", "last"],
+            [[k, v["last"]] for k, v in pod.items()],
         ))
     if digest.get("recovery"):
         rec = digest["recovery"]
@@ -373,6 +402,13 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
                 "queue" in key or "_ms" in key or "p95" in key
                 or "fence" in key
             ))
+    for key in sorted(set(a.get("pod", {})) | set(b.get("pod", {}))):
+        if key == "pod_resume_step_elected":
+            continue  # an elected step is context, not a metric to delta
+        pa = a.get("pod", {}).get(key, {})
+        pb = b.get("pod", {}).get(key, {})
+        add(key, pa.get("last"), pb.get("last"),
+            lower_better=("slack" not in key and "beats" not in key))
     for key in sorted(set(a.get("recovery", {})) | set(b.get("recovery", {}))):
         ra = a.get("recovery", {}).get(key, {})
         rb = b.get("recovery", {}).get(key, {})

@@ -29,7 +29,7 @@ from distributed_ddpg_tpu import checkpoint as ckpt_lib
 from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs import make, spec_of
-from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, Timer
+from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, PodStats, Timer
 from distributed_ddpg_tpu.ops import support_auto
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
@@ -40,6 +40,14 @@ from distributed_ddpg_tpu.replay import make_replay
 # EXIT_PREEMPTED (EX_TEMPFAIL) — distinct from the stall watchdog's 70
 # (EX_SOFTWARE, wedged device) and from ordinary crash tracebacks.
 EXIT_PREEMPTED = 75
+# Pod-degraded exit (docs/RESILIENCE.md pod rows): a PEER process of a
+# multi-host pod died or hung mid-collective (PodPeerLost). This survivor
+# took the coordinated clean abort — pending transfer tickets failed, one
+# emergency checkpoint written — and the driver should relaunch the WHOLE
+# pod with the same checkpoint dirs: the coordinated resume election
+# (parallel/multihost.elect_resume_step) restores one common step
+# everywhere, so the pod never resumes forked.
+EXIT_POD_DEGRADED = 76
 
 
 def _enable_faulthandler() -> None:
@@ -435,7 +443,20 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     )
     from distributed_ddpg_tpu.types import pack_batch_np
 
-    is_multi = multihost.initialize()
+    # The JAX runtime's own heartbeat killer must stay SLOWER than the
+    # pod layer's worst-case detection (deadline + grace), or a peer
+    # death during a granted window LOG(FATAL)s survivors before the
+    # clean abort (docs/RESILIENCE.md pod rows). Derived here so the
+    # contract holds with default config, not only when an operator
+    # remembers the POD_RUNTIME_HEARTBEAT_TIMEOUT_S override.
+    is_multi = multihost.initialize(
+        runtime_heartbeat_timeout_s=(
+            config.pod_collective_timeout_s + config.pod_startup_grace_s
+            + 120.0
+            if config.pod_collective_timeout_s > 0
+            else None
+        )
+    )
     # --- chaos harness + preemption (docs/RESILIENCE.md) ---
     # The fault plan is parsed once; each recoverable component gets its
     # own call-site injector. SIGTERM flips a flag the loop polls at chunk
@@ -446,6 +467,62 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     ckpt_fault = fault_plan.site("ckpt", "write") if fault_plan else None
     preempt = threading.Event()
     emergency_ckpt = [0]
+
+    # --- pod resilience (parallel/multihost.py; docs/RESILIENCE.md) ---
+    # Multi-process only: arm the collective deadline (a hung DCN
+    # collective surfaces as PodPeerLost within pod_collective_timeout_s
+    # instead of blocking forever) and run the one-time startup barrier
+    # with its own generous grace — startup skew under box load must be
+    # absorbed here, not read as a dead peer by the per-beat deadline.
+    # Single-process runs never configure the deadline, so every guarded
+    # call short-circuits to a direct call (zero overhead).
+    pod_stats = PodStats(seed=config.seed)
+    pod_lost: list = [None]
+
+    def _pod_degraded_early(e) -> Dict[str, float]:
+        """Peer loss BEFORE the training stack exists (startup barrier /
+        resume election): nothing to checkpoint, but the exit contract
+        still applies — main()/the pod harness must see pod_degraded and
+        exit EXIT_POD_DEGRADED (76), not a generic traceback the driver
+        would misread as 'crash: diagnose' (docs/RESILIENCE.md)."""
+        pod_lost[0] = e
+        pod_stats.record_abort()
+        print(
+            f"[train] pod peer lost during pod bootstrap: {e}; exiting "
+            f"{EXIT_POD_DEGRADED}",
+            file=sys.stderr, flush=True,
+        )
+        return {
+            "learner_steps_per_sec": 0.0,
+            "learner_steps": 0,
+            "final_return": None,
+            "param_checksum": 0.0,
+            "preempted": False,
+            "pod_degraded": True,
+            **pod_stats.snapshot(),
+        }
+
+    if is_multi:
+        multihost.configure_pod(
+            config.pod_collective_timeout_s, stats=pod_stats
+        )
+        try:
+            multihost.startup_barrier(config.pod_startup_grace_s)
+        except multihost.PodPeerLost as e:
+            multihost.configure_pod(0.0)
+            return _pod_degraded_early(e)
+
+    def _grant_all(extra_s: float) -> None:
+        """Extend BOTH stall detectors across a known-long window (first
+        chunk XLA compile, support-expansion recompile): the watchdog and
+        the pod collective deadline must agree that a compiling pod is
+        not a wedged or dead one. The pod side gets pod_startup_grace_s —
+        only the compile SKEW between processes can delay a collective,
+        and the worst-case peer-loss detection latency stays the
+        documented `pod_collective_timeout_s + grace` bound."""
+        _grant(extra_s)
+        if is_multi:
+            multihost.grant(config.pod_startup_grace_s)
 
     env = make(config.env_id, seed=config.seed)
     spec = spec_of(env)
@@ -528,6 +605,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 fault_plan.site("transfer", "dispatch")
                 if fault_plan else None
             ),
+            # Pod deadline on the lockstep lane (docs/RESILIENCE.md):
+            # every multi-host collective beat is bounded, so an
+            # in-flight beat whose peer died FAILS its ticket with
+            # PodPeerLost instead of wedging the lane.
+            lockstep_timeout_s=(
+                config.pod_collective_timeout_s if is_multi else 0.0
+            ),
         ).start()
 
     learner = ShardedLearner(
@@ -606,16 +690,79 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # (total_env_steps) spans crashes instead of restarting from zero. ---
     learn_steps = 0
     env_steps_offset = 0
-    if (
-        config.resume
-        and config.checkpoint_dir
-        and ckpt_lib.latest_step(config.checkpoint_dir) is not None
-    ):
+    # Per-process emergency-checkpoint directory (pod abort): process 0
+    # owns config.checkpoint_dir exactly as before; any OTHER survivor of
+    # a pod abort writes into a proc<k> subdirectory, so a shared
+    # filesystem never races two writers on the same step_N while
+    # per-host local disks still each get a valid emergency checkpoint.
+    pod_ckpt_dir = config.checkpoint_dir
+    if is_multi and config.checkpoint_dir and jax.process_index() != 0:
+        pod_ckpt_dir = os.path.join(
+            config.checkpoint_dir, f"proc{jax.process_index()}"
+        )
+    resume_dir = config.checkpoint_dir
+    resume_step: Optional[int] = None
+    do_resume = False
+    if config.resume and config.checkpoint_dir:
+        if is_multi:
+            # Coordinated resume (docs/RESILIENCE.md pod rows): gather
+            # each process's manifest-valid steps (main dir + its own pod
+            # emergency dir) and restore the greatest COMMON step — a
+            # step newer on only some processes would fork the pod. This
+            # is a collective: ALL processes take this path whether or
+            # not they see checkpoints locally (a conditional collective
+            # would deadlock the ones that do).
+            main_steps = set(ckpt_lib.valid_steps(config.checkpoint_dir))
+            own_steps = (
+                set(ckpt_lib.valid_steps(pod_ckpt_dir))
+                if pod_ckpt_dir != config.checkpoint_dir
+                else set()
+            )
+            try:
+                elected = multihost.elect_resume_step(main_steps | own_steps)
+            except multihost.PodPeerLost as e:
+                # A peer died before the pod even agreed on a resume
+                # step: same exit contract as a mid-run loss, minus the
+                # emergency checkpoint (no new progress exists yet). The
+                # already-built pieces (SIGTERM handler, replay shipper,
+                # transfer scheduler) sit ABOVE the main try/finally, so
+                # they are torn down here — an embedded caller must get
+                # its SIGTERM handler back (the installed one only sets a
+                # dead run's preempt flag).
+                if prev_sigterm is not None:
+                    try:
+                        signal.signal(signal.SIGTERM, prev_sigterm)
+                    except (ValueError, TypeError):
+                        pass
+                if use_device_replay and device_replay is not None:
+                    device_replay.close()
+                if transfer_sched is not None:
+                    transfer_sched.close()
+                multihost.configure_pod(0.0)
+                return _pod_degraded_early(e)
+            if elected >= 0:
+                do_resume = True
+                resume_step = elected
+                resume_dir = (
+                    config.checkpoint_dir
+                    if elected in main_steps
+                    else pod_ckpt_dir
+                )
+                pod_stats.record_resume_elected(elected)
+                trace.instant("pod_resume_elected", step=elected)
+                print(
+                    f"[pod] resume election: step {elected} is the newest "
+                    "checkpoint valid on every process"
+                )
+        elif ckpt_lib.latest_step(config.checkpoint_dir) is not None:
+            do_resume = True
+    if do_resume:
         ckpt_meta: Dict[str, object] = {}
         restored, step, env_steps_offset = ckpt_lib.restore(
-            config.checkpoint_dir,
+            resume_dir,
             learner.state,
             device_replay if use_device_replay else replay,
+            step=resume_step,
             config=config,
             meta_out=ckpt_meta,
         )
@@ -637,7 +784,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         # (pool._spawn) — no random-action re-injection mid-training.
         pool.env_steps_offset = env_steps_offset
         print(
-            f"resumed from {config.checkpoint_dir} at learner step {step}, "
+            f"resumed from {resume_dir} at learner step {step}, "
             f"env step {env_steps_offset}"
         )
 
@@ -765,6 +912,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         if use_device_replay and device_replay is not None:
             out.update(device_replay.transfer_snapshot())
         return out
+
+    def pod_fields() -> Dict[str, float]:
+        """pod_* resilience counters (metrics.PodStats; docs/RESILIENCE.md
+        pod rows) for every train/final record on multi-process runs —
+        peer losses, coordinated aborts, the elected resume step, and the
+        collective-deadline near-miss/slack telemetry. Single-process
+        records stay clean."""
+        return pod_stats.snapshot() if is_multi else {}
 
     def drain() -> int:
         # Ingest rate limiter (config.max_ingest_ratio): when the budget is
@@ -945,7 +1100,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             )
             if grown is not None:
                 learner.set_value_bounds(*grown)
-                _grant(max(300.0, 2.0 * config.watchdog_s))
+                _grant_all(max(300.0, 2.0 * config.watchdog_s))
                 print(
                     f"auto C51 support expanded to "
                     f"[{grown[0]:.1f}, {grown[1]:.1f}] "
@@ -991,6 +1146,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 # per-class dispatches/bytes/tails, queue depths, the
                 # adaptive-coalesce trajectory, restart count.
                 **transfer_fields(),
+                # Pod resilience (docs/RESILIENCE.md pod rows).
+                **pod_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -1036,6 +1193,61 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 + frac * (config.per_beta_final - config.per_beta)
             )
 
+    def _emergency_checkpoint() -> None:
+        # --- emergency checkpoint (preemption + pod-abort contract) ---
+        # One save OFF the hot loop, then a normal teardown. The
+        # in-flight cadence write (if any) lands first; its failure
+        # must not cost the emergency save. Same-step dedupe: if the
+        # cadence already wrote exactly learn_steps, that checkpoint
+        # IS the resumable state. Ordinarily only process 0 writes
+        # (state is replicated); on a POD abort every survivor writes
+        # one — process 0 into checkpoint_dir, the rest into their
+        # proc<k> subdir (pod_ckpt_dir) — so a relaunched pod can
+        # elect a common step even when each host keeps its own disk.
+        _beat()
+        try:
+            saver.wait()
+        except Exception as e:
+            print(
+                f"[train] in-flight checkpoint write failed during "
+                f"preemption ({e!r}); writing the emergency "
+                "checkpoint anyway",
+                file=sys.stderr, flush=True,
+            )
+            saver.errors.clear()
+        i_write = jax.process_index() == 0 or pod_lost[0] is not None
+        my_dir = (
+            config.checkpoint_dir if jax.process_index() == 0 else pod_ckpt_dir
+        )
+        if config.checkpoint_dir and i_write:
+            if ckpt_lib.latest_step(my_dir) != learn_steps:
+                with phases.phase("ckpt"):
+                    ckpt_lib.save(
+                        my_dir, learn_steps,
+                        learner.state,
+                        device_replay if use_device_replay else replay,
+                        config,
+                        env_steps=env_steps(),
+                        v_bounds=(
+                            (learner.config.v_min, learner.config.v_max)
+                            if config.distributional
+                            and config.v_support_auto
+                            else None
+                        ),
+                        keep=config.checkpoint_keep,
+                        retries=config.ckpt_write_retries,
+                        backoff_s=config.ckpt_retry_backoff_s,
+                        fault=ckpt_fault,
+                    )
+            emergency_ckpt[0] = 1
+            trace.instant("emergency_ckpt", step=learn_steps)
+            print(
+                f"[train] emergency checkpoint at learner step "
+                f"{learn_steps} (env step {env_steps()}) — resumable",
+                file=sys.stderr, flush=True,
+            )
+
+    prefetch = None
     try:
         # --- warmup: fill replay to the learning threshold (min_fill) ---
         # The per-iteration _beat below keeps the watchdog quiet even when
@@ -1099,6 +1311,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             warm_it += 1
 
         trace.instant("warmup_done", buffer_fill=buffer_fill())
+        if use_device_replay and is_multi and fault_plan:
+            # Pod chaos site (pod:<proc>:kill|hang@beat): armed at the
+            # warmup/steady boundary — a lockstep point — so `@beat`
+            # counts STEADY-STATE beats (one per learner chunk, the same
+            # ordinal on every process) instead of depending on how many
+            # wall-clock-paced warmup iterations actor startup needed.
+            device_replay.arm_pod_fault(
+                fault_plan.pod_site(jax.process_index())
+            )
         if (
             config.distributional and learner.config.v_support_auto
             and not preempt.is_set()  # partial warmup: no stats to size from
@@ -1144,7 +1365,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         # a slow compile isn't killed as a false stall (same exit 70 as a
         # real wedge). Consumed by the first post-compile beat; steady-state
         # iterations run on the plain watchdog_s window.
-        _grant(max(300.0, 2.0 * config.watchdog_s))
+        _grant_all(max(300.0, 2.0 * config.watchdog_s))
 
         with profile_cm:
             # Multi-host: the global budget is re-gathered every 10th
@@ -1263,50 +1484,39 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             prefetch.stop()
 
         if preempt.is_set():
-            # --- emergency checkpoint (preemption contract) ---
-            # One save OFF the hot loop, then a normal teardown. The
-            # in-flight cadence write (if any) lands first; its failure
-            # must not cost the emergency save. Same-step dedupe: if the
-            # cadence already wrote exactly learn_steps, that checkpoint
-            # IS the resumable state.
-            _beat()
+            _emergency_checkpoint()
+    except multihost.PodPeerLost as e:
+        # --- coordinated clean pod abort (docs/RESILIENCE.md pod rows) ---
+        # A peer process died or hung mid-collective: every further
+        # collective would block (or fork) the pod. Each survivor fails
+        # the transfer scheduler's pending tickets (close() fails queued
+        # work BEFORE the join — a queued lockstep beat must never fire
+        # against a degraded pod), takes one emergency checkpoint through
+        # the SIGTERM path's machinery, and exits EXIT_POD_DEGRADED so
+        # the driver relaunches the whole pod; the resume election then
+        # restores one common step everywhere.
+        pod_lost[0] = e
+        pod_stats.record_abort()
+        preempt.set()  # downstream teardown follows the preemption shape
+        _grant_all(max(300.0, 2.0 * config.watchdog_s))
+        trace.instant("pod_abort", step=learn_steps)
+        print(
+            f"[train] pod peer lost: {e}; coordinated clean abort — "
+            f"draining transfers, emergency checkpoint, exit "
+            f"{EXIT_POD_DEGRADED}",
+            file=sys.stderr, flush=True,
+        )
+        # The outstanding beat ticket (if any) is already failed or
+        # failing under the same deadline — never re-wait it.
+        pending_beat["t"] = None
+        if prefetch is not None:
             try:
-                saver.wait()
-            except Exception as e:
-                print(
-                    f"[train] in-flight checkpoint write failed during "
-                    f"preemption ({e!r}); writing the emergency "
-                    "checkpoint anyway",
-                    file=sys.stderr, flush=True,
-                )
-                saver.errors.clear()
-            if config.checkpoint_dir and jax.process_index() == 0:
-                if ckpt_lib.latest_step(config.checkpoint_dir) != learn_steps:
-                    with phases.phase("ckpt"):
-                        ckpt_lib.save(
-                            config.checkpoint_dir, learn_steps,
-                            learner.state,
-                            device_replay if use_device_replay else replay,
-                            config,
-                            env_steps=env_steps(),
-                            v_bounds=(
-                                (learner.config.v_min, learner.config.v_max)
-                                if config.distributional
-                                and config.v_support_auto
-                                else None
-                            ),
-                            keep=config.checkpoint_keep,
-                            retries=config.ckpt_write_retries,
-                            backoff_s=config.ckpt_retry_backoff_s,
-                            fault=ckpt_fault,
-                        )
-                emergency_ckpt[0] = 1
-                trace.instant("emergency_ckpt", step=learn_steps)
-                print(
-                    f"[train] emergency checkpoint at learner step "
-                    f"{learn_steps} (env step {env_steps()}) — resumable",
-                    file=sys.stderr, flush=True,
-                )
+                prefetch.stop()
+            except Exception:
+                pass
+        if transfer_sched is not None:
+            transfer_sched.close()
+        _emergency_checkpoint()
     finally:
         if prev_sigterm is not None:
             try:
@@ -1321,6 +1531,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # process issued the same beats, so every process waits here)
             # before tearing down the machinery under it.
             wait_beat()
+        except multihost.PodPeerLost as e:
+            # A peer died between the loop's last gate and teardown:
+            # record the degradation so the exit code still says 76, but
+            # keep tearing down (the abort machinery already ran or the
+            # run was otherwise complete).
+            if pod_lost[0] is None:
+                pod_lost[0] = e
+                pod_stats.record_abort()
         except Exception:
             pass  # a failing beat must not mask the primary error
         pool.stop()
@@ -1341,6 +1559,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         t = eval_thread["t"]
         if t is not None:
             t.join(timeout=60)
+        if is_multi:
+            # Disarm the module-level pod deadline: a later single-process
+            # train in the same interpreter must keep the zero-overhead
+            # short-circuit path.
+            multihost.configure_pod(0.0)
 
     # --- final eval with the trained policy (CPU, deterministic) ---
     # Skipped under preemption: the contract is "checkpoint and get out";
@@ -1360,6 +1583,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **recovery_fields(),
         **phases.snapshot(),
         **transfer_fields(),
+        **pod_fields(),
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the
@@ -1376,9 +1600,41 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         "learner_steps": learn_steps,
         "final_return": final_return,
         "param_checksum": checksum,
-        "preempted": preempt.is_set(),
+        # A pod abort reuses the preemption machinery but is its OWN
+        # documented exit (76 vs 75) — report exactly one of the two.
+        "preempted": preempt.is_set() and pod_lost[0] is None,
+        "pod_degraded": pod_lost[0] is not None,
         **recovery_fields(),
+        **pod_fields(),
     }
+
+
+def pod_degraded_exit(linger_s: float = 10.0) -> None:
+    """Exit EXIT_POD_DEGRADED the SAFE way after a coordinated pod abort
+    (train_jax returned pod_degraded=True; emergency checkpoint and logs
+    already landed).
+
+    os._exit, not sys.exit, for the same reason the stall watchdog uses
+    it: the abandoned collective thread is still blocked inside the
+    transport, and normal interpreter teardown destroys the distributed
+    runtime under it — the process then dies by std::terminate/SIGABRT
+    instead of the documented code (observed on the gloo chaos harness).
+
+    Process 0 lingers briefly first: it hosts the coordination service,
+    and its exit closes every peer's error-polling RPC — which the XLA
+    client answers with LOG(FATAL), terminating survivors still writing
+    THEIR emergency checkpoints. The aborts start near-simultaneously
+    (same missed collective), so a short linger lets the peers finish."""
+    try:
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() == 0:
+            time.sleep(linger_s)
+    except Exception:
+        pass
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(EXIT_POD_DEGRADED)
 
 
 def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None) -> float:
@@ -1403,6 +1659,8 @@ def main(argv=None) -> None:
     config = DDPGConfig.from_flags(argv if argv is not None else sys.argv[1:])
     summary = train(config)
     print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})
+    if summary.get("pod_degraded"):
+        pod_degraded_exit()
     if summary.get("preempted"):
         # The documented "preempted, resumable" exit — a driver retries
         # the run with the same checkpoint_dir instead of diagnosing it.

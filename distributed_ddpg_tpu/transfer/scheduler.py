@@ -137,6 +137,7 @@ class TransferScheduler:
         fault=None,
         max_restarts: int = 3,
         weights: Optional[Dict[str, float]] = None,
+        lockstep_timeout_s: float = 0.0,
     ):
         self.stats = stats or TransferStats()
         # Chaos harness (faults.py): ticked once per dequeued item, OUTSIDE
@@ -144,6 +145,14 @@ class TransferScheduler:
         # scheduler THREAD (the bounded-restart path under test), while a
         # work item's own exception only fails its ticket.
         self._fault = fault
+        # Pod collective deadline (parallel/multihost.call_with_deadline;
+        # docs/RESILIENCE.md pod rows): every LOCKSTEP item — multi-host
+        # collective beats — is bounded by this many seconds, so a beat
+        # whose peer died surfaces as a typed PodPeerLost in its ticket
+        # (in-flight lockstep tickets FAIL, they never hang) instead of
+        # wedging the lane forever. 0 = off (single-process runs pay
+        # zero overhead — the wrapper short-circuits).
+        self._lockstep_timeout_s = float(lockstep_timeout_s)
         self._max_restarts = int(max_restarts)
         self.restarts = 0
         self._cv = threading.Condition()
@@ -303,7 +312,16 @@ class TransferScheduler:
         t0 = time.perf_counter()
         try:
             with trace.span(f"transfer_{item.cls}", label=item.ticket.label):
-                ret = item.fn()
+                if item.cls == LOCKSTEP and self._lockstep_timeout_s > 0:
+                    from distributed_ddpg_tpu.parallel import multihost
+
+                    ret = multihost.call_with_deadline(
+                        item.fn,
+                        timeout_s=self._lockstep_timeout_s,
+                        label=item.ticket.label or "lockstep",
+                    )
+                else:
+                    ret = item.fn()
         except BaseException as e:  # the submitter's problem, not ours
             self.stats.record_dispatch(
                 item.cls, item.nbytes, time.perf_counter() - t0

@@ -35,7 +35,14 @@ whole device lifetime, including learner construction and the first
 params d2h — both observed wedge points).
 
 Coverage note: this watchdog catches LEARNER-side wedges (device calls
-that never return). An actor-side stall — workers heartbeating but
+that never return). Two adjacent failure modes are owned elsewhere and
+exit differently (docs/RESILIENCE.md exit-code contract): a HOST-initiated
+pod collective whose peer died is bounded by the pod collective deadline
+(parallel/multihost.py PodPeerLost -> coordinated clean abort, exit 76) —
+keep pod_collective_timeout_s well under watchdog_s so peer loss surfaces
+as the resumable 76, with this watchdog's 70 as the backstop for
+collectives INSIDE jitted dispatch, which no host-side deadline can
+bound. An actor-side stall — workers heartbeating but
 producing no experience — is invisible to it, because the warmup/cap
 loops beat every iteration whether or not rows moved. That blind spot is
 covered twice over: PER-WORKER by the pool monitor's zero-rows detector

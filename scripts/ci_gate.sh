@@ -2,11 +2,15 @@
 # CI bench-regression gate (ROADMAP open item; docs/OBSERVABILITY.md §3):
 # compare a candidate bench JSON against a baseline — by default the
 # newest BENCH_r*.json in the repo root that actually RESOLVES the gate
-# keys (driver rounds whose bench run died at the TPU probe leave wrapper
-# JSONs with no bench object; gating against one would SKIP every key and
-# silently pass any regression) — and exit 2 on regression past the
-# threshold, so the driver's round loop can fail fast on a
-# perf-regressing change. Exits 1 if no baseline resolves the keys.
+# keys AND carries no TPU-probe failure (driver rounds whose bench run
+# died at the TPU probe leave wrapper JSONs with truncated failure tails
+# and, since PR 6, a structured `probe_error` field in the bench object;
+# gating against the former would SKIP every key and silently pass any
+# regression, and the latter's value is a CPU fallback that would poison
+# the baseline — both are skipped with a logged reason, e.g. BENCH_r04/
+# r05) — and exit 2 on regression past the threshold, so the driver's
+# round loop can fail fast on a perf-regressing change. Exits 1 if no
+# baseline qualifies.
 #
 # Usage:
 #   scripts/ci_gate.sh <candidate.json> [baseline.json]
@@ -42,24 +46,41 @@ from distributed_ddpg_tpu.tools.runs import _lookup, load_bench
 keys = [k.lstrip("-") for k in os.environ["GATE_KEYS"].split(",") if k]
 
 
-def usable(path):
+def usable(path, why=None):
+    def skip(reason):
+        print(f"ci_gate: skipping {path}: {reason}", file=sys.stderr)
+        if why is not None:
+            why.append(reason)
+        return False
+
     try:
         obj = load_bench(path)
-    except Exception:
-        return False
-    return any(
+    except Exception as e:
+        return skip(f"unreadable ({e!r})")
+    if obj.get("probe_error"):
+        # A probe-failure run's numbers are a CPU fallback (bench.py
+        # records the failure as this structured field): gating future
+        # candidates against it would poison the baseline.
+        return skip("TPU-probe failure recorded (probe_error)")
+    if not any(
         isinstance(_lookup(obj, k), (int, float))
         and not isinstance(_lookup(obj, k), bool)
         for k in keys
-    )
+    ):
+        # Typically a driver wrapper whose tail is a truncated failure
+        # dump instead of a bench object (BENCH_r04/r05).
+        return skip(f"resolves none of the gate keys {keys} (failure tail "
+                    "or no bench object)")
+    return True
 
 
 explicit = os.environ["GATE_BASELINE"]
 if explicit:
-    if not usable(explicit):
+    why = []
+    if not usable(explicit, why):
         print(
-            f"ci_gate: baseline {explicit} resolves none of the gate keys "
-            f"{keys} — the gate would silently pass; refusing",
+            f"ci_gate: explicit baseline {explicit} unusable "
+            f"({'; '.join(why)}) — the gate would silently pass; refusing",
             file=sys.stderr,
         )
         sys.exit(1)
@@ -73,8 +94,8 @@ for path in sorted(glob.glob(os.path.join(sys.argv[1], "BENCH_r*.json")),
         print(path)
         sys.exit(0)
 print(
-    f"ci_gate: no BENCH_r*.json in {sys.argv[1]} resolves the gate keys "
-    f"{keys}", file=sys.stderr,
+    f"ci_gate: no BENCH_r*.json in {sys.argv[1]} qualifies as a baseline "
+    f"(gate keys {keys})", file=sys.stderr,
 )
 sys.exit(1)
 PY

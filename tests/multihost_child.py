@@ -25,6 +25,18 @@ Usage: python multihost_child.py <process_id> <num_processes> <port> [mode]
                  transpose) vs the seed's serial max_coalesce=1 sequence
                  in the SAME cluster — storage/ptr/size must come out
                  bit-identical (docs/INGEST.md)
+  mode = podtrain: the full train_jax loop under the POD-RESILIENCE
+                 contract (docs/RESILIENCE.md pod rows): pod fault specs
+                 (pod:<proc>:kill|hang@beat), collective deadline, and
+                 checkpoint dirs arrive via POD_* env vars; the child
+                 exits train.EXIT_POD_DEGRADED (76) when a peer is lost
+                 and 0 on a clean (or resumed) completion. Parent:
+                 tests/test_pod.py.
+
+Every mode runs `multihost.startup_barrier` right after initialize: the
+one-time generous rendezvous absorbs backend-init/import skew under box
+load, which used to surface as startup heartbeat timeouts in these
+children on contended hosts (CHANGES.md PR 5 note).
 """
 
 import os
@@ -62,12 +74,23 @@ def main() -> None:
     assert info["process_count"] == nprocs, info
     assert info["global_device_count"] == 2 * nprocs, info
 
+    # Startup hardening (ISSUE 6 satellite): rendezvous once with a
+    # generous grace so a peer still paying backend-init/import cost
+    # under box load doesn't turn the first real collective into a
+    # "startup heartbeat timeout" flake. Distinct from (and much larger
+    # than) any steady-state collective deadline the mode then arms.
+    multihost.startup_barrier(
+        float(os.environ.get("POD_STARTUP_GRACE_S", "240"))
+    )
+
     import numpy as np
 
     from distributed_ddpg_tpu.config import DDPGConfig
     from distributed_ddpg_tpu.parallel.learner import ShardedLearner
 
-    if mode == "chunk":
+    if mode == "podtrain":
+        run_pod_train(pid, tag=f"proc{pid}")
+    elif mode == "chunk":
         run_parity_chunk(ShardedLearner, DDPGConfig, np, tag=f"proc{pid}")
     elif mode == "replay":
         run_replay_parity(pid, nprocs, tag=f"proc{pid}")
@@ -81,6 +104,97 @@ def main() -> None:
         run_fused_mesh_parity(tag=f"proc{pid}")
     else:
         raise SystemExit(f"unknown mode {mode!r}")
+
+
+def run_pod_train(pid: int, tag: str) -> None:
+    """Full train_jax under the pod-resilience contract. Parameterized by
+    env vars (the parent launches N identical children, so per-run knobs
+    can't ride argv):
+
+      POD_FAULTS          --faults plan (e.g. 'pod:1:kill@40'); same
+                          string everywhere — only the targeted process
+                          fires, every process ticks the beat ordinal
+      POD_CKPT_DIR        shared checkpoint dir ('' = no checkpoints)
+      POD_LOG_DIR         JSONL dir; this child writes proc<pid>.jsonl
+      POD_TOTAL_STEPS     global env-step budget
+      POD_TIMEOUT_S       pod_collective_timeout_s
+      POD_STARTUP_GRACE_S pod_startup_grace_s (also the barrier above)
+      POD_BG_SYNC         '1' = background sync_ship beats (the
+                          production default). Default '0' here: chunk
+                          execution overlapping lane beats can tickle a
+                          pre-existing concurrent-gloo-collective race
+                          on the multiprocess CPU backend (the PR-5
+                          child-flake note), and THIS harness is pinning
+                          the pod-abort contract, not the overlap.
+
+    Prints 'PODRESULT <tag> steps=<n> degraded=<0|1> elected=<step>' and
+    exits with train.py's documented code (76 on pod degradation, 75 on
+    preemption, 0 clean) so the parent asserts the REAL contract."""
+    import tempfile
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.train import EXIT_PREEMPTED, train_jax
+
+    # The multiprocess CPU backend races concurrently-executing
+    # computations that both carry gloo collectives (async dispatch lets
+    # the sync_ship insert / learner chunk still be executing when the
+    # next host gather runs — observed as nondeterministic
+    # `gloo EnforceNotMet op.preamble.length <= op.nbytes` stream
+    # corruption). Synchronous dispatch serializes the per-process device
+    # stream, so the only collective failures this harness sees are the
+    # INJECTED ones under test. CPU-test-only: real TPU backends separate
+    # collective channels in hardware.
+    import jax as _jax
+
+    _jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    log_dir = os.environ.get("POD_LOG_DIR", "")
+    config = DDPGConfig(
+        backend="jax_tpu",
+        env_id="Pendulum-v1",
+        actor_hidden=(16, 16),
+        critic_hidden=(16, 16),
+        batch_size=16,
+        num_actors=1,
+        total_env_steps=int(os.environ.get("POD_TOTAL_STEPS", "200000")),
+        replay_min_size=128,
+        replay_capacity=8192,
+        eval_every=0,
+        eval_episodes=1,
+        checkpoint_dir=os.environ.get("POD_CKPT_DIR", ""),
+        # Small cadence so the pod has retained checkpoints besides the
+        # emergency one — the resume election must pick among several.
+        checkpoint_every=int(os.environ.get("POD_CKPT_EVERY", "64")),
+        faults=os.environ.get("POD_FAULTS", ""),
+        pod_collective_timeout_s=float(os.environ.get("POD_TIMEOUT_S", "20")),
+        pod_startup_grace_s=float(
+            os.environ.get("POD_STARTUP_GRACE_S", "240")
+        ),
+        sync_ship_background=os.environ.get("POD_BG_SYNC", "0") == "1",
+        log_path=(
+            os.path.join(log_dir, f"proc{pid}.jsonl")
+            if log_dir
+            else tempfile.mktemp(suffix=".jsonl")
+        ),
+        # The pod deadline owns hang detection here; the watchdog's
+        # os._exit(70) would race the clean-abort path under test.
+        watchdog_s=0.0,
+    )
+    out = train_jax(config)
+    print(
+        f"PODRESULT {tag} steps={out['learner_steps']} "
+        f"degraded={int(bool(out.get('pod_degraded')))} "
+        f"elected={out.get('pod_resume_step_elected', -1)}",
+        flush=True,
+    )
+    if out.get("pod_degraded"):
+        # The documented exit discipline (leader linger + os._exit) —
+        # the same call train.main() makes.
+        from distributed_ddpg_tpu.train import pod_degraded_exit
+
+        pod_degraded_exit()
+    if out.get("preempted"):
+        raise SystemExit(EXIT_PREEMPTED)
 
 
 def run_fused_mesh_parity(tag: str) -> None:
