@@ -99,6 +99,13 @@ def _config():
         batch_size=BATCH,
         num_actors=16,
         replay_capacity=200_000,
+        # BENCH_GUARDRAILS=1: measure with the numerical-health probe
+        # armed (guardrails.py — forces the scan path, so A/B it against
+        # a default run to see the probe's cost; the guardrail_* counters
+        # then ride the bench JSON and ci_gate.sh's -guardrail_rollbacks
+        # key arms against them). Default off: the headline number stays
+        # the megakernel path.
+        guardrails=os.environ.get("BENCH_GUARDRAILS", "0") == "1",
     )
 
 
@@ -246,6 +253,20 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
             **sched.snapshot(), **device_replay.transfer_snapshot(),
         }
     phase_fields = phases.snapshot()
+    # Numerical health (BENCH_GUARDRAILS=1): the probe's cumulative
+    # counters for the measured loop. guardrail_rollbacks is 0 by
+    # construction here (bench runs the learner loop, not the repair
+    # loop) — its presence arms ci_gate.sh's -guardrail_rollbacks key, so
+    # a future bench that DOES skip/roll back fails the gate loudly.
+    guard_fields = {}
+    if learner.guard_enabled:
+        h = learner.poll_health() or {}
+        guard_fields = {
+            "guardrail_rollbacks": 0,
+            "guardrail_skipped_updates": h.get("skipped", 0),
+            "guardrail_nonfinite_steps": h.get("nonfinite", 0),
+            "guardrail_loss_spikes": h.get("spikes", 0),
+        }
     device_replay.close()
     if sched is not None:
         sched.close()
@@ -278,6 +299,8 @@ def _measure_jax(config, replay, seconds: float, mesh=None, chunk=None) -> dict:
         # Transfer-scheduler breakdown (docs/TRANSFER.md): per-class
         # dispatches/bytes/tails + the adaptive-coalesce trajectory.
         **transfer_fields,
+        # Numerical health (BENCH_GUARDRAILS=1 only).
+        **guard_fields,
     }
     peak = _peak_flops(dev.device_kind)
     if peak is not None:

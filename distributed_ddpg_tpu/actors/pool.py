@@ -318,7 +318,7 @@ class ActorPool:
             "next_obs": rows[:, o + a + 2 : 2 * o + a + 2],
         }
 
-    def _pop_ring_batches(self, max_rows: Optional[int]) -> List[Dict[str, np.ndarray]]:
+    def _pop_ring_batches(self, max_rows: Optional[int]) -> List[tuple]:
         out = []
         remaining = self.config.shm_ring_rows * self.num_actors if max_rows is None else int(max_rows)
         for wid, ring in enumerate(self._rings):
@@ -336,7 +336,7 @@ class ActorPool:
                 # row; rows are in production order, so the last row carries
                 # the freshest tag.
                 self._note_version(wid, decode_version(rows[-1, -1]))
-                out.append(self._rows_to_batch(rows))
+                out.append((wid, self._rows_to_batch(rows)))
                 self._steps_received += rows.shape[0]
                 remaining -= rows.shape[0]
         return out
@@ -347,7 +347,7 @@ class ActorPool:
         budget); overshoot is at most one queue batch on the queue path."""
         moved = 0
         if self.transport == "shm":
-            for batch in self._pop_ring_batches(max_rows):
+            for _wid, batch in self._pop_ring_batches(max_rows):
                 replay.add_batch(
                     batch["obs"],
                     batch["action"],
@@ -377,12 +377,17 @@ class ActorPool:
         return moved
 
     def drain_batches(
-        self, max_batches: int = 1000, max_rows: Optional[int] = None
-    ) -> List[Dict[str, np.ndarray]]:
+        self, max_batches: int = 1000, max_rows: Optional[int] = None,
+        with_sources: bool = False,
+    ) -> List:
         """Pop pending transition batches raw (for the device-replay ingest
-        path, which packs them itself); returns a list of field dicts."""
+        path, which packs them itself); returns a list of field dicts — or,
+        with_sources=True, of (worker_id, fields) pairs so the guardrails'
+        bad-row quarantine (train.py) can attribute non-finite replay rows
+        back to the slot that produced them."""
         if self.transport == "shm":
-            return self._pop_ring_batches(max_rows)
+            pairs = self._pop_ring_batches(max_rows)
+            return pairs if with_sources else [b for _, b in pairs]
         out = []
         moved = 0
         for _ in range(max_batches):
@@ -393,7 +398,7 @@ class ActorPool:
             except queue_mod.Empty:
                 break
             self._note_version(wid, version)
-            out.append(batch)
+            out.append((wid, batch) if with_sources else batch)
             moved += len(batch["reward"])
         self._steps_received += moved
         return out
@@ -553,6 +558,42 @@ class ActorPool:
             elif now - self._last_rows_t[i] > no_progress_s:
                 return "no_rows"
         return None
+
+    def quarantine_source(self, worker_id: int, why: str = "numeric") -> bool:
+        """Quarantine one slot DIRECTLY — the guardrails' bad-row path
+        (train.py): a worker repeatedly feeding non-finite experience is
+        poisoning replay even though its process looks healthy, so it goes
+        through the same breaker state the crash-loop detector uses
+        (loud stderr, training continues degraded, probing un-quarantines
+        it after quarantine_probe_s if it comes back clean). Returns False
+        when the slot is already quarantined."""
+        i = int(worker_id)
+        if not 0 <= i < self.num_actors or self._quarantined[i]:
+            return False
+        p = self._procs[i]
+        if p is not None and p.is_alive():
+            p.terminate()
+            p.join(timeout=2.0)
+        self._procs[i] = None
+        self._probing[i] = False
+        self._pending_respawn[i] = False
+        self._fail_times[i] = []
+        self._quarantined[i] = True
+        self._quarantined_at[i] = time.time()
+        trace.instant("actor_quarantined", worker=i, why=why)
+        print(
+            f"[pool] QUARANTINED worker {i} ({why}): repeatedly produced "
+            "non-finite experience rows — respawns suspended, training "
+            "continues degraded on "
+            f"{self.num_actors - self.quarantined_count} workers"
+            + (
+                f"; probe in {self.config.quarantine_probe_s:.0f}s"
+                if self.config.quarantine_probe_s > 0
+                else ""
+            ),
+            file=sys.stderr, flush=True,
+        )
+        return True
 
     @property
     def quarantined_count(self) -> int:

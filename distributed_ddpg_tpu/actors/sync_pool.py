@@ -207,14 +207,21 @@ class SyncActorPool:
         return [batch]
 
     def drain_batches(
-        self, max_batches: int = 1000, max_rows: Optional[int] = None
-    ) -> List[Dict[str, np.ndarray]]:
+        self, max_batches: int = 1000, max_rows: Optional[int] = None,
+        with_sources: bool = False,
+    ) -> List:
         if max_rows is None or max_rows <= 0:
             # strict_sync requires the ingest gate armed (config.py), so a
             # budget always arrives on the hot path; the warmup loop's
             # budget is the min-fill allowance.
             return []
-        return self._produce(int(max_rows))
+        batches = self._produce(int(max_rows))
+        if with_sources:
+            # Inline actors interleave round-robin into ONE batch; there
+            # is no per-row source to attribute (and no process to
+            # quarantine) — the guardrails treat -1 as "untracked".
+            return [(-1, b) for b in batches]
+        return batches
 
     def drain_into(self, replay, max_batches: int = 1000,
                    max_rows: Optional[int] = None) -> int:
@@ -251,3 +258,8 @@ class SyncActorPool:
         # Inline actors cannot crash independently of the driver; the
         # counters exist for JSONL-schema parity with ActorPool.
         return {"actor_respawns": 0, "actor_quarantined": 0}
+
+    def quarantine_source(self, worker_id: int, why: str = "numeric") -> bool:
+        # Inline actors share the driver process; there is nothing to
+        # quarantine (surface parity with ActorPool for the guardrails).
+        return False

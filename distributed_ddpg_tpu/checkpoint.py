@@ -147,17 +147,34 @@ def _steps(directory: str):
 
 
 def _prune(directory: str, keep: int, current: int) -> None:
-    """Delete old step_*/config_*/manifest_* triples, retaining the newest
-    `keep` — ALWAYS including `current`, the checkpoint that just landed:
-    sorting alone would delete the fresh save when the directory holds
-    higher-numbered stale checkpoints from a previous run (the
-    --resume=false reuse workflow check_config_compatible suggests).
-    Runs on the writer thread after a successful save; best-effort (a
-    failed unlink must not fail the save that just landed)."""
+    """Delete old step_*/config_*/manifest_* triples, retaining `current`
+    (the checkpoint that just landed) plus the newest `keep`-1 steps BELOW
+    it. Steps ABOVE current are stale by definition — leftovers of a
+    previous run sharing the directory (the --resume=false reuse workflow
+    check_config_compatible suggests) or of a diverged timeline a
+    guardrail rollback rewound past — and are pruned too, loudly: left in
+    place they would permanently occupy the retention slots (every save
+    would delete the run's OWN previous checkpoint, losing the keep-1
+    crash redundancy) and keep latest_step()/resume pointing at state this
+    run never produced. Runs on the writer thread after a successful save;
+    best-effort (a failed unlink must not fail the save that just
+    landed)."""
     if keep <= 0:
         return
-    others = [s for s in _steps(directory) if s != current]
-    for old in others[: -(keep - 1)] if keep > 1 else others:
+    steps = _steps(directory)
+    stale_above = [s for s in steps if s > current]
+    below = [s for s in steps if s < current]
+    if stale_above:
+        print(
+            f"[checkpoint] pruning stale checkpoint(s) above the current "
+            f"save step_{current}: "
+            + ", ".join(f"step_{s}" for s in stale_above)
+            + " (previous-run or pre-rollback leftovers — resume must "
+            "track THIS run's latest state)",
+            file=sys.stderr, flush=True,
+        )
+    doomed = stale_above + (below[: -(keep - 1)] if keep > 1 else below)
+    for old in doomed:
         try:
             shutil.rmtree(os.path.join(directory, f"step_{old}"),
                           ignore_errors=True)
@@ -477,6 +494,44 @@ def _compat_eq(a, b) -> bool:
     if _is_auto(a) and _is_auto(b):
         return True
     return a == b
+
+
+def discard_above(directory: str, step: int) -> list:
+    """Quarantine every retained checkpoint NEWER than `step` out of the
+    step_N namespace (-> diverged_step_N; sidecars removed so
+    latest_step()/valid_steps() stop seeing them, payload kept for
+    forensics — the _quarantine_corrupt discipline). The guardrail
+    rollback (train.py) calls this right after restoring `step`:
+    checkpoints written after the divergence began are poisoned by
+    assumption, and a crash landing before the next clean save must
+    resume from `step`, not from them. Returns the steps discarded."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    discarded = []
+    for s in _steps(directory):
+        if s <= step:
+            continue
+        src = os.path.join(directory, f"step_{s}")
+        dst = os.path.join(directory, f"diverged_step_{s}")
+        try:
+            if os.path.isdir(dst):
+                shutil.rmtree(dst, ignore_errors=True)
+            os.rename(src, dst)
+            for side in (f"manifest_{s}.json", f"config_{s}.json"):
+                side_path = os.path.join(directory, side)
+                if os.path.exists(side_path):
+                    os.unlink(side_path)
+            discarded.append(s)
+        except OSError:
+            pass
+    if discarded:
+        print(
+            "[checkpoint] rollback quarantined diverged checkpoint(s): "
+            + ", ".join(f"step_{s} -> diverged_step_{s}" for s in discarded),
+            file=sys.stderr, flush=True,
+        )
+    return discarded
 
 
 def latest_step(directory: str) -> Optional[int]:

@@ -329,6 +329,46 @@ class DDPGConfig:
     # up to this many times with exponential backoff before surfacing.
     ckpt_write_retries: int = 2
     ckpt_retry_backoff_s: float = 0.5
+    # --- numerical-health guardrails (guardrails.py; docs/RESILIENCE.md) ---
+    # On-device divergence detection fused into the learner chunk: finite
+    # checks on TD targets/grads/updated params plus EWMA z-score anomaly
+    # detection on critic loss & grad norm; a bad step's update is DROPPED
+    # on device (bad-batch quarantine), non-finite sampled replay rows are
+    # recorded for ingest-source attribution, and sustained divergence
+    # triggers automatic rollback to the last manifest-valid checkpoint.
+    # Off by default: guardrails force the XLA scan path (the Pallas
+    # megakernel has no probe slot), add one tiny health-word d2h sync per
+    # chunk, and the disabled path is pinned bit-identical to the
+    # pre-guardrail programs (tests/test_guardrails.py parity). Turn on
+    # for unattended/production runs.
+    guardrails: bool = False
+    # One-sided z-score threshold for the loss/grad-norm anomaly detector
+    # (divergence is always UP). Generous by default: a false skip drops
+    # one update; a false rollback costs a checkpoint cadence.
+    guardrail_zmax: float = 8.0
+    # Clean steps the EWMA absorbs before z-scores arm (early-training
+    # loss scale is nonstationary; finite checks are armed from step 1).
+    guardrail_warmup_steps: int = 64
+    # Rollback trigger: this many anomalous (skipped) learner steps within
+    # guardrail_rollback_window steps -> restore the last manifest-valid
+    # checkpoint (PR-4 restore walk; pods coordinate the step through the
+    # PR-6 election). 0 = detect/skip/quarantine only, never roll back.
+    guardrail_rollback_k: int = 8
+    guardrail_rollback_window: int = 256
+    # Rollback budget: a run that needs more than this many rollbacks (or
+    # needs one with no restorable checkpoint) aborts with the documented
+    # EXIT_NUMERIC (77) instead of thrashing restore/diverge forever.
+    guardrail_max_rollbacks: int = 3
+    # LR cooldown on rollback: both learner LRs scale by this factor after
+    # a rollback and restore once guardrail_lr_cooldown_steps clean steps
+    # pass (each transition costs one XLA recompile, like a support
+    # expansion). 1.0 = off.
+    guardrail_lr_backoff: float = 0.5
+    guardrail_lr_cooldown_steps: int = 2000
+    # Ingest-source quarantine: this many non-finite replay rows attributed
+    # to the same actor slot quarantine that slot through the pool's
+    # breaker machinery (probing un-quarantines it later). 0 = off.
+    guardrail_source_offenses: int = 3
     # --- pod resilience (parallel/multihost.py; docs/RESILIENCE.md) ---
     # Deadline on every host-initiated DCN collective (sync_ship beats,
     # the env-budget all-gather, the scheduler's lockstep lane): a
@@ -590,6 +630,42 @@ class DDPGConfig:
             raise ValueError("ckpt_write_retries must be >= 0")
         if self.ckpt_retry_backoff_s < 0:
             raise ValueError("ckpt_retry_backoff_s must be >= 0")
+        if self.guardrails:
+            if self.backend != "jax_tpu":
+                raise ValueError(
+                    "guardrails instrument the sharded-learner chunk "
+                    "programs (jax_tpu backend); the native/ondevice "
+                    "backends have no probe slot"
+                )
+            if self.fused_chunk == "on":
+                raise ValueError(
+                    "guardrails=True forces the XLA scan path (the Pallas "
+                    "megakernel has no health-probe slot) — incompatible "
+                    "with fused_chunk='on'; use 'auto' (degrades to scan) "
+                    "or 'off'"
+                )
+        if self.guardrail_zmax <= 0:
+            raise ValueError("guardrail_zmax must be > 0")
+        if self.guardrail_warmup_steps < 1:
+            raise ValueError("guardrail_warmup_steps must be >= 1")
+        if self.guardrail_rollback_k < 0:
+            raise ValueError(
+                "guardrail_rollback_k must be >= 0 (0 = never roll back)"
+            )
+        if self.guardrail_rollback_window < 1:
+            raise ValueError("guardrail_rollback_window must be >= 1")
+        if self.guardrail_max_rollbacks < 0:
+            raise ValueError("guardrail_max_rollbacks must be >= 0")
+        if not 0.0 < self.guardrail_lr_backoff <= 1.0:
+            raise ValueError(
+                "guardrail_lr_backoff must be in (0, 1] (1.0 = off)"
+            )
+        if self.guardrail_lr_cooldown_steps < 1:
+            raise ValueError("guardrail_lr_cooldown_steps must be >= 1")
+        if self.guardrail_source_offenses < 0:
+            raise ValueError(
+                "guardrail_source_offenses must be >= 0 (0 = off)"
+            )
         if self.pod_collective_timeout_s < 0:
             raise ValueError("pod_collective_timeout_s must be >= 0 (0 = off)")
         if self.pod_startup_grace_s < 0:

@@ -18,7 +18,9 @@ Grammar (';'-separated specs):
 
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
     component := worker | pool | shipper | prefetch | ckpt | transfer | pod
+                 | numeric
     kind      := crash | crashloop | hang | stall | slow | ioerror | kill
+                 | nan | inf | spike
 
 `at` is 1-based: for `worker` it is the env step inside that worker's
 FIRST incarnation (a respawned worker gets a clean slate — except
@@ -61,6 +63,23 @@ Fault semantics by component:
     pod:<proc>:hang@K~S      process <proc> freezes S seconds (default:
                              effectively forever) at its K-th beat — the
                              hung-peer flavor of the same contract
+    numeric:grad:nan@K       the K-th guarded learner step computes against
+                             a NaN-poisoned minibatch (NaN grads/TD) — the
+                             guardrails probe (guardrails.py) must skip the
+                             update and, sustained, roll back
+    numeric:replay:inf@K     the K-th ingested env-step row lands in replay
+                             with reward=+inf (host-side poisoning at drain
+                             time) — the bad-row sample detector must
+                             record it and attribute its ingest source
+    numeric:loss:spike@K     the K-th guarded learner step sees rewards
+                             scaled 1e6 (finite, absurd) — the EWMA z-score
+                             anomaly detector's territory
+
+Numeric `at` ordinals count GUARDED learner steps on a monotonic clock
+(guardrails.GuardState.total) that is deliberately NOT rolled back by the
+guardrails' checkpoint rollback — a step-keyed fault that re-fired after
+every rollback would loop the run into its own repair forever. They are
+consumed at program build time (parallel/learner.py), not via FaultSite.
 
 The legacy one-shot hook `--inject_fault=actor:<id>:<step>` is accepted as
 an alias for `worker:<id>:crash@<step>`.
@@ -83,8 +102,9 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
-              "pod")
-KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill")
+              "pod", "numeric")
+KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill",
+         "nan", "inf", "spike")
 
 # Worker `slow` faults throttle this many consecutive env steps, then lift
 # — bounded so a chaos soak keeps making progress past the fault.
@@ -96,6 +116,9 @@ SLOW_FAULT_STEPS = 200
 _WORKER_KINDS = ("crash", "crashloop", "hang", "stall", "slow")
 _SITE_KINDS = ("crash", "hang", "slow", "ioerror")
 _POD_KINDS = ("kill", "hang")
+# Numeric faults are target->kind pairs (each target poisons one specific
+# detector of the guardrails probe): grad->nan, replay->inf, loss->spike.
+_NUMERIC_PAIRS = {"grad": "nan", "replay": "inf", "loss": "spike"}
 
 
 class InjectedFault(OSError):
@@ -196,6 +219,27 @@ class FaultPlan:
         with the (identical-everywhere) beat sequence."""
         return self.site("pod", str(int(process_index)))
 
+    def numeric_steps(self) -> Dict[str, Tuple[int, ...]]:
+        """Guarded-learner-step ordinals for the IN-PROGRAM numeric faults
+        ('grad' -> NaN batch, 'loss' -> 1e6-scaled rewards), consumed at
+        chunk-program build time (parallel/learner.py). 'replay' specs are
+        host-side (see numeric_replay_rows) and excluded here."""
+        out: Dict[str, List[int]] = {}
+        for s in self.specs:
+            if s.component == "numeric" and s.target in ("grad", "loss"):
+                out.setdefault(s.target, []).append(s.at)
+        return {k: tuple(sorted(v)) for k, v in out.items()}
+
+    def numeric_replay_rows(self) -> Tuple[int, ...]:
+        """Ingested-row ordinals (1-based, per process) whose reward is
+        poisoned to +inf at drain time (train.py) — the deterministic
+        'poisoned replay row' chaos vector for the bad-row sample detector
+        and its source-quarantine path."""
+        return tuple(sorted(
+            s.at for s in self.specs
+            if s.component == "numeric" and s.target == "replay"
+        ))
+
 
 def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
     def bad(why: str) -> ValueError:
@@ -260,6 +304,16 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
             int(target)
         except ValueError:
             raise bad("pod target must be an integer process id") from None
+    elif component == "numeric":
+        if target not in _NUMERIC_PAIRS:
+            raise bad(
+                f"numeric target must be one of {tuple(_NUMERIC_PAIRS)}"
+            )
+        if kind != _NUMERIC_PAIRS[target]:
+            raise bad(
+                f"numeric:{target} takes kind {_NUMERIC_PAIRS[target]!r} "
+                f"(got {kind!r}) — grad:nan, replay:inf, loss:spike"
+            )
     else:
         if kind not in _SITE_KINDS:
             raise bad(f"kind {kind!r} does not apply to host sites")

@@ -438,6 +438,83 @@ class PodStats:
             }
 
 
+class GuardrailStats:
+    """Host-side numerical-health counters (guardrails.py;
+    docs/RESILIENCE.md 'Numerical health') — the `guardrail_*` family
+    every train/final JSONL record carries when guardrails are armed.
+    CUMULATIVE like PodStats (divergence events are rare and terminal-ish;
+    interval resets would hide the one record that matters):
+
+      guardrail_anomalies          anomalous learner steps (nonfinite +
+                                   z-score spikes) — the rollback trigger's
+                                   input
+      guardrail_nonfinite_steps    steps skipped for a non-finite
+                                   TD/grad/param value
+      guardrail_loss_spikes        steps skipped by the EWMA z-score
+                                   detector (finite but absurd)
+      guardrail_skipped_updates    total updates dropped on device
+      guardrail_bad_rows           non-finite sampled replay rows seen
+      guardrail_rollbacks          checkpoint rollback-repairs taken
+      guardrail_last_rollback_step the manifest-valid step the latest
+                                   rollback restored (-1 = none)
+      guardrail_lr_cooldowns       LR backoff->restore cycles completed
+      guardrail_source_quarantines ingest sources quarantined for
+                                   repeatedly feeding non-finite rows
+
+    `absorb(health)` mirrors the device probe's cumulative counters and
+    returns the DELTA since the previous read — the rolling-window input
+    for the rollback trigger (train.py)."""
+
+    def __init__(self):
+        self.nonfinite = 0
+        self.spikes = 0
+        self.skipped = 0
+        self.bad_rows = 0
+        self.total_steps = 0
+        self.rollbacks = 0
+        self.last_rollback_step = -1
+        self.lr_cooldowns = 0
+        self.source_quarantines = 0
+
+    def absorb(self, health: Dict[str, int]) -> Dict[str, int]:
+        delta = {
+            "nonfinite": int(health.get("nonfinite", 0)) - self.nonfinite,
+            "spikes": int(health.get("spikes", 0)) - self.spikes,
+            "skipped": int(health.get("skipped", 0)) - self.skipped,
+            "bad_rows": int(health.get("bad_rows", 0)) - self.bad_rows,
+        }
+        self.nonfinite = int(health.get("nonfinite", 0))
+        self.spikes = int(health.get("spikes", 0))
+        self.skipped = int(health.get("skipped", 0))
+        self.bad_rows = int(health.get("bad_rows", 0))
+        self.total_steps = int(health.get("total", 0))
+        delta["anomalies"] = delta["nonfinite"] + delta["spikes"]
+        return delta
+
+    def record_rollback(self, step: int) -> None:
+        self.rollbacks += 1
+        self.last_rollback_step = int(step)
+
+    def record_lr_cooldown(self) -> None:
+        self.lr_cooldowns += 1
+
+    def record_source_quarantine(self) -> None:
+        self.source_quarantines += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "guardrail_anomalies": self.nonfinite + self.spikes,
+            "guardrail_nonfinite_steps": self.nonfinite,
+            "guardrail_loss_spikes": self.spikes,
+            "guardrail_skipped_updates": self.skipped,
+            "guardrail_bad_rows": self.bad_rows,
+            "guardrail_rollbacks": self.rollbacks,
+            "guardrail_last_rollback_step": self.last_rollback_step,
+            "guardrail_lr_cooldowns": self.lr_cooldowns,
+            "guardrail_source_quarantines": self.source_quarantines,
+        }
+
+
 class Timer:
     """Running steps/sec meter for the actor/learner rate metrics.
     Monotonic clock: a wall-clock jump (NTP step, manual date set) on a

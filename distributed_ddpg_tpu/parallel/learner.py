@@ -129,6 +129,20 @@ class ShardedLearner:
             )
 
         self.obs_dim, self.act_dim = obs_dim, act_dim
+        # Numerical-health guardrails (guardrails.py): the chunk programs
+        # thread a small replicated GuardState through the scan and emit a
+        # per-chunk health word. Off (default) builds the exact pre-
+        # guardrail programs — the parity test pins bit-identity.
+        self.guard_enabled = bool(config.guardrails)
+        self._numeric_inject = (
+            config.fault_plan().numeric_steps()
+            if self.guard_enabled and config.faults
+            else {}
+        )
+        self._health_cur = None
+        # LR cooldown hook (train.py rollback-repair): both LRs scale by
+        # _lr_scale; set_lr_scale rebuilds the (lazily compiled) programs.
+        self._lr_scale = 1.0
         state = init_train_state(config, obs_dim, act_dim, config.seed)
         self._state_sharding = mesh_lib.to_named(
             self.mesh, mesh_lib.state_pspec(state, self.mesh)
@@ -151,6 +165,13 @@ class ShardedLearner:
             jax.random.PRNGKey(config.seed),
             NamedSharding(self.mesh, P()),
         )
+        if self.guard_enabled:
+            from distributed_ddpg_tpu import guardrails as guard_lib
+
+            self._guard = jax.device_put(
+                guard_lib.init_guard_state(),
+                NamedSharding(self.mesh, P()),
+            )
 
     def set_value_bounds(self, v_min: float, v_max: float) -> None:
         """Swap the C51 support bounds and rebuild the (lazily compiled)
@@ -173,6 +194,14 @@ class ShardedLearner:
         # probes read afterwards.
         prior_kernel_error = getattr(self, "fused_chunk_error", None)
         config = self.config
+        if self._lr_scale != 1.0:
+            # Guardrail LR cooldown (train.py rollback-repair): the scale
+            # applies at program build, so every path — scan, PER, fused —
+            # sees the identical effective LR.
+            config = config.replace(
+                actor_lr=config.actor_lr * self._lr_scale,
+                critic_lr=config.critic_lr * self._lr_scale,
+            )
         mode = self.mode
         obs_dim, act_dim = self.obs_dim, self.act_dim
         action_scale = self._action_scale
@@ -260,11 +289,15 @@ class ShardedLearner:
         # replaces K tiny ones: 59.5k -> 89.5k steps/s with unroll=4
         # (v5e-1, chunk=800). Shared by the scan and megakernel paths so
         # their index streams stay bit-identical (parity tests rely on it).
-        def draw_chunk(key, storage, size):
+        def draw_chunk_idx(key, size):
             key, sub = jax.random.split(key)
             idx = jax.random.randint(
                 sub, (self.chunk_size, batch_size), 0, jnp.maximum(size, 1)
             )
+            return key, idx
+
+        def draw_chunk(key, storage, size):
+            key, idx = draw_chunk_idx(key, size)
             return key, storage[idx]
 
         def sample_chunk_fn(s: TrainState, key, storage, size):
@@ -285,6 +318,10 @@ class ShardedLearner:
         # must never be silently replaced by the megakernel.
         envelope_ok = (
             config.fused_chunk != "off"
+            # Guardrails need the probe threaded through every step — the
+            # megakernel has no slot for it, so the scan path wins
+            # (config validation rejects fused_chunk='on' + guardrails).
+            and not config.guardrails
             and self.mode == "auto"
             and fused_chunk_lib.supported(config)
             and fused_chunk_lib.fits_vmem(config, obs_dim, act_dim)
@@ -454,6 +491,166 @@ class ShardedLearner:
             else self._scan_sample_chunk_step
         )
         self._sample_chunk_compiled = False
+
+        if self.guard_enabled:
+            # --- guarded chunk programs (guardrails.py) ---
+            # The same scan bodies with the health probe threaded through:
+            # each program additionally takes/returns the replicated
+            # GuardState (donated) and emits the per-chunk health word;
+            # the sampling paths also screen the raw gathered rows and
+            # capture bad replay indices for source attribution. jit is
+            # lazy, so the unguarded builds above cost nothing.
+            from distributed_ddpg_tpu import guardrails as guard_lib
+
+            gstep = guard_lib.make_guarded_step(
+                step,
+                zmax=config.guardrail_zmax,
+                warmup=config.guardrail_warmup_steps,
+                inject=self._numeric_inject,
+            )
+
+            def guarded_scan(s, g, batches, pre_bad):
+                def body(carry, x):
+                    cs, cg = carry
+                    b, pb = x
+                    ns, ng, td, ms = gstep(cs, cg, b, pb)
+                    return (ns, ng), (td, ms)
+
+                (s, g), (tds, ms) = jax.lax.scan(
+                    body, (s, g), (batches, pre_bad), unroll=self.unroll
+                )
+                return StepOutput(
+                    state=s,
+                    td_errors=tds,
+                    metrics=jax.tree.map(lambda x: jnp.mean(x), ms),
+                ), g
+
+            def guard_chunk_fn(s: TrainState, packed, g):
+                # Host-fed path: the sampler owns replay indices, so the
+                # row screen reports counts only (bad_idx rides as -1s).
+                pre_bad, bad_count, _ = guard_lib.batch_row_health(
+                    packed, None
+                )
+                g = g._replace(bad_rows=g.bad_rows + bad_count)
+                out, g = guarded_scan(
+                    s, g, unpack_batch(packed, obs_dim, act_dim), pre_bad
+                )
+                return out, g, guard_lib.health_vector(g)
+
+            self._chunk_step = jax.jit(
+                guard_chunk_fn,
+                in_shardings=(
+                    self._state_sharding, self._chunk_sharding, replicated,
+                ),
+                out_shardings=(
+                    StepOutput(
+                        state=self._state_sharding,
+                        td_errors=td_chunk_sharding,
+                        metrics={k: replicated for k in METRIC_KEYS},
+                    ),
+                    replicated,
+                    replicated,
+                ),
+                donate_argnums=(0, 2),
+            )
+
+            def guard_sample_chunk_fn(s: TrainState, key, storage, size, g):
+                key, idx = draw_chunk_idx(key, size)
+                packed = storage[idx]
+                packed = jax.lax.with_sharding_constraint(
+                    packed, NamedSharding(self.mesh, P(None, "data", None))
+                )
+                pre_bad, bad_count, bad_idx = guard_lib.batch_row_health(
+                    packed, idx
+                )
+                g = g._replace(bad_rows=g.bad_rows + bad_count)
+                out, g = guarded_scan(
+                    s, g, unpack_batch(packed, obs_dim, act_dim), pre_bad
+                )
+                return out, key, g, guard_lib.health_vector(g), bad_idx
+
+            guard_out = (
+                StepOutput(
+                    state=self._state_sharding,
+                    td_errors=td_chunk_sharding,
+                    metrics={k: replicated for k in METRIC_KEYS},
+                ),
+                replicated,  # key
+                replicated,  # guard state
+                replicated,  # health word
+                replicated,  # bad replay indices
+            )
+            self._sample_chunk_step = jax.jit(
+                guard_sample_chunk_fn,
+                in_shardings=(
+                    self._state_sharding, replicated, storage_sharding,
+                    replicated, replicated,
+                ),
+                out_shardings=guard_out,
+                donate_argnums=(0, 1, 4),
+            )
+            self._scan_sample_chunk_step = self._sample_chunk_step
+
+            def guard_per_sample_chunk_fn(s, key, storage, size, priorities,
+                                          maxp, beta, alpha, eps, g):
+                key, sub = jax.random.split(key)
+                idx, weights = draw_per_indices(
+                    sub, priorities, size, (self.chunk_size, batch_size),
+                    beta,
+                )
+                packed = storage[idx]
+                packed = jax.lax.with_sharding_constraint(
+                    packed, NamedSharding(self.mesh, P(None, "data", None))
+                )
+                weights = jax.lax.with_sharding_constraint(
+                    weights, NamedSharding(self.mesh, P(None, "data"))
+                )
+                pre_bad, bad_count, bad_idx = guard_lib.batch_row_health(
+                    packed, idx
+                )
+                g = g._replace(bad_rows=g.bad_rows + bad_count)
+                batches = unpack_batch(packed, obs_dim, act_dim)._replace(
+                    weight=weights
+                )
+                out, g = guarded_scan(s, g, batches, pre_bad)
+                # A bad step's td errors are zeroed by the probe, so its
+                # sampled rows re-stamp at the (eps)^alpha floor instead
+                # of inheriting NaN priorities that would poison every
+                # later draw.
+                new_p = (jnp.abs(out.td_errors) + eps) ** alpha
+                priorities = priorities.at[idx.reshape(-1)].set(
+                    new_p.reshape(-1)
+                )
+                maxp = jnp.maximum(maxp, new_p.max())
+                return (
+                    out, key, priorities, maxp, g,
+                    guard_lib.health_vector(g), bad_idx,
+                )
+
+            self._per_sample_chunk_step = jax.jit(
+                guard_per_sample_chunk_fn,
+                in_shardings=(
+                    self._state_sharding, replicated, storage_sharding,
+                    replicated, prio_sharding, replicated, replicated,
+                    replicated, replicated, replicated,
+                ),
+                out_shardings=(
+                    StepOutput(
+                        state=self._state_sharding,
+                        td_errors=NamedSharding(self.mesh, P(None, "data")),
+                        metrics={k: replicated for k in METRIC_KEYS},
+                    ),
+                    replicated,
+                    prio_sharding,
+                    replicated,
+                    replicated,
+                    replicated,
+                    replicated,
+                ),
+                donate_argnums=(0, 1, 4, 9),
+            )
+            self._scan_per_sample_chunk_step = self._per_sample_chunk_step
+
         self.fused_chunk_error: Optional[str] = None
         if prior_kernel_error is not None:
             # Stay degraded (see note at the top of this method) — same
@@ -581,14 +778,19 @@ class ShardedLearner:
 
     def run_chunk(self, np_batches: Dict[str, np.ndarray]) -> StepOutput:
         """np_batches fields are [K, B, ...] stacked minibatches."""
-        out = self._chunk_step(self.state, self.put_chunk(np_batches))
-        self.state = out.state
-        return out
+        return self.run_chunk_async(self.put_chunk(np_batches))
 
     def run_chunk_async(self, device_chunk) -> StepOutput:
         """Same as run_chunk but takes an already-device_put packed chunk
         (from the prefetch pipeline) and does not block — callers sync on
         the outputs."""
+        if self.guard_enabled:
+            out, self._guard, health = self._chunk_step(
+                self.state, device_chunk, self._guard
+            )
+            self._health_cur = (health, None)
+            self.state = out.state
+            return out
         out = self._chunk_step(self.state, device_chunk)
         self.state = out.state
         return out
@@ -615,6 +817,15 @@ class ShardedLearner:
         must re-raise rather than retry against deleted arrays."""
         with _ingest_lock(device_replay):
             storage, size = device_replay.device_state()
+            if self.guard_enabled:
+                out, self._key, self._guard, health, bad_idx = (
+                    self._sample_chunk_step(
+                        self.state, self._key, storage, size, self._guard
+                    )
+                )
+                self._health_cur = (health, bad_idx)
+                self.state = out.state
+                return out
             try:
                 out, self._key = self._sample_chunk_step(
                     self.state, self._key, storage, size
@@ -665,6 +876,17 @@ class ShardedLearner:
                 np.float32(beta), np.float32(device_replay.alpha),
                 np.float32(device_replay.eps),
             )
+            if self.guard_enabled:
+                out, self._key, new_p, new_maxp, self._guard, health, bad_idx = (
+                    self._per_sample_chunk_step(
+                        self.state, self._key, storage, size, priorities,
+                        maxp, *args, self._guard,
+                    )
+                )
+                self._health_cur = (health, bad_idx)
+                self.state = out.state
+                device_replay.set_per_state(new_p, new_maxp)
+                return out
             try:
                 out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
                     self.state, self._key, storage, size, priorities, maxp, *args
@@ -738,3 +960,84 @@ class ShardedLearner:
             "d2h", fetch, label="metrics_d2h",
             nbytes_of=lambda r: 8 * len(r),
         )
+
+    # --- numerical-health guardrails (guardrails.py) ---
+
+    def poll_health(self) -> Optional[Dict[str, int]]:
+        """Cumulative probe counters of the most recent guarded chunk —
+        the one tiny d2h the guardrail monitor pays per chunk (it syncs
+        the chunk's health word only, never params). None before the
+        first guarded dispatch or with guardrails off."""
+        if not self.guard_enabled or self._health_cur is None:
+            return None
+        from distributed_ddpg_tpu import guardrails as guard_lib
+
+        def fetch():
+            with trace.span("health_d2h"):
+                vec = np.asarray(jax.device_get(self._health_cur[0]))
+            return dict(
+                zip(guard_lib.HEALTH_KEYS, (int(v) for v in vec))
+            )
+
+        if self.transfer is None:
+            return fetch()
+        return self.transfer.run_inline(
+            "d2h", fetch, label="health_d2h",
+            nbytes_of=lambda r: 4 * len(r),
+        )
+
+    def bad_indices(self) -> np.ndarray:
+        """Replay indices of the non-finite rows the last guarded chunk
+        sampled (first guardrails.GUARD_BAD_IDX; device pads with -1,
+        filtered here). Fetch only when the health word shows fresh
+        bad_rows — this d2h rides the rare bad path."""
+        if not self.guard_enabled or self._health_cur is None:
+            return np.empty(0, np.int64)
+        bad = self._health_cur[1]
+        if bad is None:
+            return np.empty(0, np.int64)
+        arr = np.asarray(jax.device_get(bad)).astype(np.int64)
+        return arr[arr >= 0]
+
+    def reset_guard(self) -> None:
+        """Re-arm the probe after a rollback: EWMA statistics reset (the
+        restored params have the pre-divergence loss scale), cumulative
+        counters and the monotonic step clock survive (the host's delta
+        accounting and the numeric-fault ordinals key on them)."""
+        if not self.guard_enabled:
+            return
+        from distributed_ddpg_tpu import guardrails as guard_lib
+
+        h = self.poll_health() or {}
+        self._guard = jax.device_put(
+            guard_lib.init_guard_state(
+                total=h.get("total", 0),
+                nonfinite=h.get("nonfinite", 0),
+                spikes=h.get("spikes", 0),
+                skipped=h.get("skipped", 0),
+                bad_rows=h.get("bad_rows", 0),
+            ),
+            NamedSharding(self.mesh, P()),
+        )
+        self._health_cur = None
+
+    def reseed(self, salt: int) -> None:
+        """Fold `salt` into the device sampling key. Rollback-repair calls
+        this so the resumed trajectory draws DIFFERENT minibatches than
+        the one that diverged — restoring state alone would replay the
+        identical sample stream into the identical divergence."""
+        self._key = jax.random.fold_in(self._key, int(salt))
+
+    @property
+    def lr_scale(self) -> float:
+        return self._lr_scale
+
+    def set_lr_scale(self, scale: float) -> None:
+        """Scale both learner LRs (guardrail rollback cooldown). Rebuilds
+        the lazily-compiled chunk programs like set_value_bounds — one XLA
+        recompile at the next dispatch, state/key/guard untouched."""
+        scale = float(scale)
+        if scale == self._lr_scale:
+            return
+        self._lr_scale = scale
+        self._build_programs()

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from functools import partial
 from typing import Optional
 
@@ -132,6 +133,7 @@ class DeviceReplay:
         host_pool: bool = False,
         background_sync: bool = False,
         pod_fault=None,
+        track_sources: bool = False,
     ):
         self.capacity = int(capacity)
         self.obs_dim = obs_dim
@@ -248,6 +250,25 @@ class DeviceReplay:
             bool(background_sync) and scheduler is not None and self._procs > 1
         )
         self._beat = 0
+
+        # --- ingest-source attribution (guardrails.py bad-row quarantine) ---
+        # A host-side mirror of "which actor slot produced the row at each
+        # storage position": add_packed tags staged rows with a source id,
+        # a FIFO of (source, count) runs parallel to the staging ring, and
+        # every successful ship stamps the landed positions using a host
+        # mirror of the device insert pointer (advanced only on success,
+        # exactly like the device ptr). Multi-host stamps only THIS
+        # process's interleave slots (each process drains — and can
+        # quarantine — only its own workers). Off (default): zero
+        # bookkeeping, sources_of reports -1 (untracked).
+        self._track_sources = bool(track_sources)
+        self._source_map = (
+            np.full(self.capacity, -1, np.int32)
+            if self._track_sources else None
+        )
+        self._src_fifo: deque = deque()  # mutable [source, rows] run-lengths
+        self._host_ptr = 0
+        self._proc_idx = jax.process_index() if self._procs > 1 else 0
 
         # Background shipper (single-process only: multi-host rows may
         # leave the host ONLY via the lockstep sync_ship collective).
@@ -404,6 +425,53 @@ class DeviceReplay:
                 return
             raise IngestError("ingest shipper thread died") from exc
 
+    # --- ingest-source attribution helpers (see __init__) ---
+
+    def _pop_sources_locked(self, n: int) -> Optional[np.ndarray]:
+        """Consume n rows' worth of source tags from the FIFO (caller holds
+        _staging, at the same moment it pops the ring so the two stay in
+        lockstep). Padding/short entries report -1."""
+        if not self._track_sources:
+            return None
+        out = np.full(n, -1, np.int32)
+        i = 0
+        while i < n and self._src_fifo:
+            entry = self._src_fifo[0]
+            take = min(entry[1], n - i)
+            out[i : i + take] = entry[0]
+            entry[1] -= take
+            if entry[1] == 0:
+                self._src_fifo.popleft()
+            i += take
+        return out
+
+    def _note_shipped(self, srcs: Optional[np.ndarray],
+                      offsets: Optional[np.ndarray], advance: int) -> None:
+        """Advance the host insert-pointer mirror past one SUCCESSFUL ship
+        of `advance` rows and stamp the landed positions: `offsets` (row
+        offsets from the pre-ship pointer) get `srcs`, everything else in
+        the advanced range is marked untracked (-1) — other processes'
+        interleave slots, padding."""
+        if not self._track_sources:
+            return
+        pos_all = (self._host_ptr + np.arange(advance)) % self.capacity
+        self._source_map[pos_all] = -1
+        if srcs is not None and offsets is not None:
+            pos = (self._host_ptr + offsets) % self.capacity
+            self._source_map[pos] = srcs
+        self._host_ptr = (self._host_ptr + advance) % self.capacity
+
+    def sources_of(self, idx) -> np.ndarray:
+        """Actor-slot ids that produced the rows at replay positions `idx`
+        (-1 = untracked: sources off, another process's rows, restored
+        contents, or padding). Best-effort under the async shipper — the
+        map is stamped post-ship without a reader lock; attribution feeds
+        a repeat-offender threshold, not an exact count."""
+        idx = np.asarray(idx, np.int64)
+        if self._source_map is None:
+            return np.full(idx.shape, -1, np.int32)
+        return self._source_map[idx % self.capacity]
+
     def _coalesce_k(self, n_blocks: int, cap_blocks: int, cap: Optional[int] = None) -> int:
         """Blocks to fold into the next super-block ship: largest power of
         two <= min(staged, coalesce cap, capacity) — capacity-capped so
@@ -452,6 +520,7 @@ class DeviceReplay:
                     if buf is not None
                     else self._ring.pop(n)
                 )
+                srcs = self._pop_sources_locked(n)
                 self._staging.notify_all()
             t0 = time.perf_counter()
             try:
@@ -467,6 +536,12 @@ class DeviceReplay:
                 raise
             dt = time.perf_counter() - t0
             self._stats.record_ship(n, k, dt)
+            # Source map advances only with a ship that actually landed —
+            # like the device ptr, so the mirror can never drift on the
+            # bounded-restart path (the popped rows AND their source tags
+            # are lost together).
+            if srcs is not None:
+                self._note_shipped(srcs, np.arange(n), n)
             if buf is not None:
                 # Fence on the insert's OUTPUT: the buffer recirculates
                 # only after the op that read the transferred chunk has
@@ -532,13 +607,15 @@ class DeviceReplay:
             self._submit_ingest_locked()
         return shipped * self.width * 4
 
-    def add_packed(self, block: np.ndarray) -> None:
+    def add_packed(self, block: np.ndarray, source: int = -1) -> None:
         """Stage packed [M, D] rows in the host ring; ship in fixed-size
         blocks (fixed power-of-two super-block shapes -> a bounded set of
         compiled inserts, no retrace churn). Multi-host: stages ONLY —
         rows leave via the lockstep sync_ship(). async_ship mode: the
         shipper thread does the device work; a full ring blocks here
-        (backpressure, counted as ingest_stall_ms)."""
+        (backpressure, counted as ingest_stall_ms). `source` tags the
+        rows' ingest source (actor slot) for the guardrails' bad-row
+        attribution when track_sources is on; -1 = untracked."""
         self._check_shipper()
         rows = np.asarray(block, np.float32)
         stall = 0.0
@@ -565,6 +642,8 @@ class DeviceReplay:
                         "ingest_backpressure", t0, stall, rows=len(rows)
                     )
             self._ring.push(rows)
+            if self._track_sources and len(rows):
+                self._src_fifo.append([int(source), len(rows)])
             self._stats.record_push(len(rows), stall)
             self._staging.notify_all()
             self._submit_ingest_locked()
@@ -599,6 +678,9 @@ class DeviceReplay:
             with self._staging:
                 n = len(self._ring)
                 rows = self._ring.pop(n) if (n >= min_rows and n > 0) else None
+                srcs = (
+                    self._pop_sources_locked(n) if rows is not None else None
+                )
                 if rows is not None:
                     self._staging.notify_all()
             if rows is not None:
@@ -608,6 +690,15 @@ class DeviceReplay:
                 with trace.span("ingest_flush", rows=n):
                     self._ship(chunk)
                 self._stats.record_ship(n, 1, time.perf_counter() - t0)
+                if srcs is not None:
+                    # Padding repeats real rows, so the copies inherit the
+                    # originals' source tags (a poisoned row's duplicate
+                    # is just as attributable).
+                    self._note_shipped(
+                        np.tile(srcs, reps)[: self.block_size],
+                        np.arange(self.block_size),
+                        self.block_size,
+                    )
 
     def sync_ship(self, force: bool = False) -> int:
         """Multi-host-safe ingest step. ALL processes must call this at the
@@ -697,6 +788,7 @@ class DeviceReplay:
                     k = self._coalesce_k(remaining, cap_blocks)
                     with self._staging:
                         rows = self._ring.pop(k * self.block_size)
+                        srcs = self._pop_sources_locked(k * self.block_size)
                     t0 = time.perf_counter()
                     with trace.span(
                         "ingest_ship_global", rows=k * self.block_size,
@@ -706,6 +798,21 @@ class DeviceReplay:
                     self._stats.record_ship(
                         k * self.block_size, k, time.perf_counter() - t0
                     )
+                    if srcs is not None:
+                        # This process's k blocks land interleaved at
+                        # offsets j*(procs*bs) + p*bs + r (the permuted
+                        # scatter in _get_global_insert); other processes'
+                        # slots stay -1 — each process attributes (and
+                        # quarantines) only its own workers.
+                        bs, procs, p = (
+                            self.block_size, self._procs, self._proc_idx,
+                        )
+                        offsets = (
+                            np.arange(k)[:, None] * (procs * bs)
+                            + p * bs
+                            + np.arange(bs)[None, :]
+                        ).reshape(-1)
+                        self._note_shipped(srcs, offsets, procs * k * bs)
                     moved += k * self.block_size
                     remaining -= k
                 if force and m % self.block_size:
@@ -716,6 +823,7 @@ class DeviceReplay:
                     take = min(count - moved, self.block_size)
                     with self._staging:
                         rows = self._ring.pop(take)
+                        srcs = self._pop_sources_locked(take)
                     reps = -(-self.block_size // take)
                     t0 = time.perf_counter()
                     self._ship_global(
@@ -724,6 +832,15 @@ class DeviceReplay:
                     self._stats.record_ship(
                         take, 1, time.perf_counter() - t0
                     )
+                    if srcs is not None:
+                        bs, procs, p = (
+                            self.block_size, self._procs, self._proc_idx,
+                        )
+                        self._note_shipped(
+                            np.tile(srcs, reps)[:bs],
+                            p * bs + np.arange(bs),
+                            procs * bs,
+                        )
                     moved += take
         return moved
 
@@ -829,6 +946,12 @@ class DeviceReplay:
                 scalar = NamedSharding(self._mesh, P())
                 self.ptr = jax.device_put(self.ptr, scalar)
                 self.size = jax.device_put(self.size, scalar)
+            if self._track_sources:
+                # Restored rows carry no attribution; re-sync the pointer
+                # mirror with the restored device ptr.
+                self._source_map.fill(-1)
+                self._src_fifo.clear()
+                self._host_ptr = int(state["ptr"]) % self.capacity
 
 
 def draw_per_indices(key, priorities, size, shape, beta):

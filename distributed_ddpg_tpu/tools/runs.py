@@ -176,6 +176,22 @@ POD_KEYS = (
     "pod_collective_slack_p95_ms",
 )
 
+# Numerical-health counters (metrics.GuardrailStats; docs/RESILIENCE.md
+# 'Numerical health') — present only when guardrails are armed. Cumulative,
+# so the digest reports the LAST value; a nonzero rollback count means the
+# run repaired itself at least once mid-flight.
+GUARDRAIL_KEYS = (
+    "guardrail_anomalies",
+    "guardrail_nonfinite_steps",
+    "guardrail_loss_spikes",
+    "guardrail_skipped_updates",
+    "guardrail_bad_rows",
+    "guardrail_rollbacks",
+    "guardrail_last_rollback_step",
+    "guardrail_lr_cooldowns",
+    "guardrail_source_quarantines",
+)
+
 
 def summarize_run(path: str) -> Dict[str, Any]:
     """Machine-readable digest of one JSONL run (the CLI renders it; tests
@@ -256,6 +272,15 @@ def summarize_run(path: str) -> Dict[str, Any]:
             pod[key] = {"last": vals[-1], "max": max(vals)}
     digest["pod"] = pod
 
+    # Numerical-health digest (guardrail-armed runs only): last value of
+    # each cumulative guardrail_* counter across train+final records.
+    guardrail = {}
+    for key in GUARDRAIL_KEYS:
+        vals = _col(train + final, key)
+        if vals:
+            guardrail[key] = {"last": vals[-1], "max": max(vals)}
+    digest["guardrail"] = guardrail
+
     recovery = {}
     for key in RECOVERY_KEYS:
         vals = _col(train + final, key)
@@ -328,6 +353,13 @@ def render_summary(digest: Dict[str, Any]) -> str:
         out.append(render_table(
             ["field", "last"],
             [[k, v["last"]] for k, v in pod.items()],
+        ))
+    if digest.get("guardrail"):
+        g = digest["guardrail"]
+        out.append("\n-- numerical health (docs/RESILIENCE.md; guardrails)")
+        out.append(render_table(
+            ["field", "last"],
+            [[k, v["last"]] for k, v in g.items()],
         ))
     if digest.get("recovery"):
         rec = digest["recovery"]
@@ -409,6 +441,14 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
         pb = b.get("pod", {}).get(key, {})
         add(key, pa.get("last"), pb.get("last"),
             lower_better=("slack" not in key and "beats" not in key))
+    for key in sorted(
+        set(a.get("guardrail", {})) | set(b.get("guardrail", {}))
+    ):
+        if key == "guardrail_last_rollback_step":
+            continue  # a restore step is context, not a metric to delta
+        ga = a.get("guardrail", {}).get(key, {})
+        gb = b.get("guardrail", {}).get(key, {})
+        add(key, ga.get("last"), gb.get("last"), lower_better=True)
     for key in sorted(set(a.get("recovery", {})) | set(b.get("recovery", {}))):
         ra = a.get("recovery", {}).get(key, {})
         rb = b.get("recovery", {}).get(key, {})
@@ -467,7 +507,24 @@ def gate_bench(
             lines.append(f"FAIL {key}: missing from candidate ({cand!r})")
             continue
         if base == 0:
-            lines.append(f"SKIP {key}: baseline is 0")
+            if lower_better and isinstance(base, int):
+                # A zero baseline on a lower-is-better COUNTER (e.g.
+                # -guardrail_rollbacks) is a real pin: any nonzero
+                # candidate is a regression from "never happened", which
+                # no relative threshold can express. Int-typed only:
+                # latency keys (-ingest_ship_ms, -transfer_*_p95) emit
+                # FLOAT 0.0 when their reservoir saw no samples, and
+                # "no samples" must keep SKIPping, not fail the first
+                # candidate that records any.
+                bad = cand > 0
+                lines.append(
+                    f"{'FAIL' if bad else 'ok':4s} {key}: baseline=0 "
+                    f"candidate={cand:g} (zero-baseline pin, "
+                    "lower-is-better counter)"
+                )
+                ok = ok and not bad
+            else:
+                lines.append(f"SKIP {key}: baseline is 0")
             continue
         ratio = cand / base
         if lower_better:

@@ -29,7 +29,13 @@ from distributed_ddpg_tpu import checkpoint as ckpt_lib
 from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs import make, spec_of
-from distributed_ddpg_tpu.metrics import MetricsLogger, PhaseTimers, PodStats, Timer
+from distributed_ddpg_tpu.metrics import (
+    GuardrailStats,
+    MetricsLogger,
+    PhaseTimers,
+    PodStats,
+    Timer,
+)
 from distributed_ddpg_tpu.ops import support_auto
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
@@ -48,6 +54,14 @@ EXIT_PREEMPTED = 75
 # (parallel/multihost.elect_resume_step) restores one common step
 # everywhere, so the pod never resumes forked.
 EXIT_POD_DEGRADED = 76
+# Numeric-health abort (docs/RESILIENCE.md 'Numerical health'): the
+# guardrails detected sustained divergence but the rollback budget is
+# exhausted (guardrail_max_rollbacks) or no manifest-valid checkpoint
+# exists to roll back to. The params are presumed poisoned, so NO
+# checkpoint is written on this path — the driver should inspect the
+# guardrail_* counters in the final JSONL record and the last retained
+# (pre-divergence) checkpoint rather than blindly relaunching.
+EXIT_NUMERIC = 77
 
 
 def _enable_faulthandler() -> None:
@@ -468,6 +482,25 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     preempt = threading.Event()
     emergency_ckpt = [0]
 
+    # --- numerical-health guardrails (guardrails.py; docs/RESILIENCE.md) ---
+    # The learner's chunk programs carry the on-device probe; this side
+    # holds the host half: per-chunk health-word reads, the rolling
+    # anomaly window that triggers rollback-repair, bad-row -> ingest-
+    # source attribution, and the LR cooldown. All trigger inputs (health
+    # counters, learn_steps) are replicated/identical across processes,
+    # so a pod takes every rollback on the same chunk.
+    guard_on = config.guardrails
+    gstats = GuardrailStats()
+    guard_window: list = []            # (learn_steps at read, anomaly count)
+    guard_src_offenses: Dict[int, int] = {}
+    numeric_failed = [False]
+    lr_backoff_since = [-1]            # learn_steps at LR backoff; -1 = none
+    # numeric:replay:inf@k (faults.py): poison the k-th ingested row's
+    # reward to +inf at drain time — the deterministic bad-replay-row
+    # chaos vector (device-replay path; ordinals are per process).
+    numeric_replay_at = fault_plan.numeric_replay_rows() if fault_plan else ()
+    ingested_rows = [0]
+
     # --- pod resilience (parallel/multihost.py; docs/RESILIENCE.md) ---
     # Multi-process only: arm the collective deadline (a hung DCN
     # collective surfaces as PodPeerLost within pod_collective_timeout_s
@@ -661,6 +694,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 transfer_sched is not None and config.transfer_host_pool
             ),
             background_sync=config.sync_ship_background,
+            # Guardrail bad-row attribution: map storage positions back
+            # to the actor slot that produced them (guardrails.py).
+            track_sources=(
+                guard_on and config.guardrail_source_offenses > 0
+            ),
         )
         device_replay = (
             DevicePrioritizedReplay(
@@ -921,6 +959,258 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         records stay clean."""
         return pod_stats.snapshot() if is_multi else {}
 
+    def guardrail_fields() -> Dict[str, int]:
+        """guardrail_* numerical-health counters (metrics.GuardrailStats;
+        docs/RESILIENCE.md 'Numerical health') for every train/final
+        record when guardrails are armed. Records stay clean otherwise."""
+        return gstats.snapshot() if guard_on else {}
+
+    def _guard_quarantine_sources() -> None:
+        """Bad-row -> ingest-source attribution: fetch the offending
+        replay indices the probe captured (the rare-path d2h), map them
+        to the actor slots that produced them, and quarantine slots past
+        the repeat-offender threshold through the pool's breaker
+        machinery (probing un-quarantines a recovered slot later)."""
+        if not use_device_replay or config.guardrail_source_offenses <= 0:
+            return
+        idx = learner.bad_indices()
+        if not len(idx):
+            return
+        srcs = device_replay.sources_of(idx)
+        for s in srcs:
+            s = int(s)
+            if s < 0:
+                continue  # untracked: restored rows, padding, other procs
+            guard_src_offenses[s] = guard_src_offenses.get(s, 0) + 1
+            if guard_src_offenses[s] >= config.guardrail_source_offenses:
+                guard_src_offenses[s] = 0  # a probed comeback re-counts
+                if pool.quarantine_source(s, why="numeric"):
+                    gstats.record_source_quarantine()
+
+    def _numeric_abort(why: str) -> bool:
+        """Rollback impossible (budget exhausted / nothing to restore):
+        flag the documented EXIT_NUMERIC abort. Deliberately writes NO
+        checkpoint — the live params are presumed poisoned, and the last
+        retained pre-divergence checkpoint must stay the newest state a
+        resume can find."""
+        numeric_failed[0] = True
+        trace.instant("numeric_abort", step=learn_steps)
+        print(
+            f"[guardrail] NUMERIC ABORT at learner step {learn_steps}: "
+            f"{why}; exiting {EXIT_NUMERIC} (no checkpoint written — the "
+            "last retained pre-divergence checkpoint stands)",
+            file=sys.stderr, flush=True,
+        )
+        return True
+
+    def _rollback_or_abort() -> bool:
+        """Automatic rollback-repair (docs/RESILIENCE.md): restore the
+        last manifest-valid checkpoint through the PR-4 fallback walk
+        (pods elect the step through the PR-6 election so hosts never
+        fork), reseed exploration so the repaired run draws a different
+        batch stream, optionally back off the LRs for a cooldown, and
+        quarantine the diverged-timeline checkpoints. Bounded by
+        guardrail_max_rollbacks -> EXIT_NUMERIC. Returns True (the caller
+        skips the rest of its chunk work) on both rollback and abort."""
+        nonlocal learn_steps, last_ckpt, next_refresh, last_refresh_t
+        if gstats.rollbacks >= config.guardrail_max_rollbacks:
+            return _numeric_abort(
+                f"rollback budget exhausted "
+                f"({gstats.rollbacks}/{config.guardrail_max_rollbacks})"
+            )
+        if not config.checkpoint_dir:
+            return _numeric_abort(
+                "sustained divergence with no checkpoint_dir to roll "
+                "back to"
+            )
+        wait_beat()  # no collective may be outstanding across the restore
+        try:
+            saver.wait()  # land (or surface) the in-flight cadence write
+        except Exception as e:
+            print(
+                f"[guardrail] in-flight checkpoint write failed before "
+                f"rollback ({e!r}); restoring from the last retained "
+                "checkpoint",
+                file=sys.stderr, flush=True,
+            )
+            saver.errors.clear()
+        replay_obj = device_replay if use_device_replay else replay
+        # Host-replay path: the prefetcher samples under replay_lock, so
+        # the restore's load_state_dict must hold it too (the device
+        # replay serializes on its own dispatch lock). Chunks already
+        # prefetched from the pre-rollback buffer are stale-but-valid
+        # replay data and may still be consumed.
+        restore_lock = (
+            contextlib.nullcontext() if use_device_replay else replay_lock
+        )
+        ckpt_meta: Dict[str, object] = {}
+        try:
+            if is_multi:
+                # Coordinated rollback step (PR-6 election): every process
+                # reaches this point on the same chunk (the trigger inputs
+                # are replicated), gathers its manifest-valid steps, and
+                # restores the greatest COMMON one. In bg_sync mode the
+                # election rides the scheduler's lockstep lane like every
+                # other host-initiated collective (docs/TRANSFER.md).
+                steps_set = set(ckpt_lib.valid_steps(config.checkpoint_dir))
+
+                def _elect() -> int:
+                    return multihost.elect_resume_step(steps_set)
+
+                elected = (
+                    transfer_sched.run_ordered(
+                        _elect, label="rollback_elect"
+                    )
+                    if bg_sync
+                    else _elect()
+                )
+                if elected < 0:
+                    return _numeric_abort(
+                        "no manifest-valid checkpoint is common to every "
+                        "process"
+                    )
+                with restore_lock:
+                    restored, step, _env = ckpt_lib.restore(
+                        config.checkpoint_dir, learner.state, replay_obj,
+                        step=elected, config=config, meta_out=ckpt_meta,
+                    )
+            else:
+                with restore_lock:
+                    restored, step, _env = ckpt_lib.restore(
+                        config.checkpoint_dir, learner.state, replay_obj,
+                        step=None, config=config, meta_out=ckpt_meta,
+                    )
+        except (FileNotFoundError, RuntimeError) as e:
+            return _numeric_abort(f"no restorable checkpoint ({e})")
+        learner.state = jax.device_put(restored, learner._state_sharding)
+        rolled_from = learn_steps
+        learn_steps = step
+        last_ckpt = step
+        if (
+            config.distributional and config.v_support_auto
+            and "v_bounds" in ckpt_meta
+        ):
+            # The restored critic's logits are only meaningful over the
+            # atom values it was trained against (resume-path rule).
+            learner.set_value_bounds(*ckpt_meta["v_bounds"])
+        learner.reset_guard()
+        guard_window.clear()
+        gstats.record_rollback(step)
+        # Reseed exploration: restoring state alone would replay the
+        # IDENTICAL sample stream into the identical divergence.
+        learner.reseed(0x6A4D + gstats.rollbacks)
+        if config.guardrail_lr_backoff < 1.0:
+            learner.set_lr_scale(config.guardrail_lr_backoff)
+            lr_backoff_since[0] = learn_steps
+        if jax.process_index() == 0:
+            # Diverged-timeline checkpoints must not win a later resume
+            # race (a crash before the next clean save would otherwise
+            # restore exactly the state just rolled away from).
+            ckpt_lib.discard_above(config.checkpoint_dir, step)
+        with phases.phase("refresh"):
+            pool.broadcast(learner.actor_params_to_host(), learn_steps)
+        next_refresh = learn_steps + config.param_refresh_every
+        last_refresh_t = time.perf_counter()
+        # The rebuilt programs recompile at the next dispatch — same
+        # allowance discipline as a support expansion.
+        _grant_all(max(300.0, 2.0 * config.watchdog_s))
+        trace.instant("rollback", step=step, rolled_from=rolled_from)
+        print(
+            f"[guardrail] ROLLBACK #{gstats.rollbacks}: restored "
+            f"manifest-valid step {step} (diverged at ~{rolled_from}); "
+            "exploration reseeded"
+            + (
+                f", LR x{config.guardrail_lr_backoff} until "
+                f"{config.guardrail_lr_cooldown_steps} clean steps pass"
+                if config.guardrail_lr_backoff < 1.0
+                else ""
+            ),
+            file=sys.stderr, flush=True,
+        )
+        return True
+
+    def _guardrail_monitor() -> bool:
+        """Per-chunk health check: read the probe's health word (one tiny
+        d2h — the only per-chunk sync guardrails add), difference it into
+        the rolling anomaly window, attribute bad rows, and trigger
+        rollback / LR-cooldown transitions. Returns True when this chunk's
+        remaining work should be skipped (rollback or abort happened) —
+        a replicated decision, so pods skip the same beats everywhere."""
+        h = learner.poll_health()
+        if h is None:
+            return False
+        delta = gstats.absorb(h)
+        if delta["bad_rows"] > 0:
+            _guard_quarantine_sources()
+        if delta["anomalies"] > 0:
+            trace.instant(
+                "nan_batch", step=learn_steps,
+                anomalies=delta["anomalies"],
+                nonfinite=delta["nonfinite"], spikes=delta["spikes"],
+            )
+            print(
+                f"[guardrail] {delta['anomalies']} anomalous learner "
+                f"step(s) in the chunk ending at {learn_steps} "
+                f"(nonfinite {delta['nonfinite']}, z-spikes "
+                f"{delta['spikes']}, bad replay rows {delta['bad_rows']})"
+                " — update(s) dropped on device",
+                file=sys.stderr, flush=True,
+            )
+            guard_window.append((learn_steps, delta["anomalies"]))
+        # Effective window: never narrower than two chunks. Health lands
+        # once per chunk stamped at the chunk's END, so a window below
+        # the chunk size (TPU chunks auto-resolve to 800 vs the 256-step
+        # default window) would prune every previous chunk's entry
+        # immediately and the trigger could only ever see one chunk.
+        win = max(config.guardrail_rollback_window, 2 * chunk)
+        lo = learn_steps - win
+        guard_window[:] = [(s, n) for s, n in guard_window if s > lo]
+        handled = False
+        if (
+            config.guardrail_rollback_k > 0
+            and sum(n for _, n in guard_window)
+            >= config.guardrail_rollback_k
+        ):
+            handled = _rollback_or_abort()
+        if (
+            not handled
+            and lr_backoff_since[0] >= 0
+            and not guard_window
+            and learn_steps - lr_backoff_since[0]
+            >= config.guardrail_lr_cooldown_steps
+        ):
+            learner.set_lr_scale(1.0)
+            lr_backoff_since[0] = -1
+            gstats.record_lr_cooldown()
+            trace.instant("lr_cooldown", step=learn_steps)
+            _grant_all(max(300.0, 2.0 * config.watchdog_s))
+            print(
+                f"[guardrail] LR cooldown complete at step {learn_steps}:"
+                " learning rates restored",
+                file=sys.stderr, flush=True,
+            )
+        return handled
+
+    def _poison_packed(packed):
+        """numeric:replay:inf@k chaos (faults.py): the k-th ingested row
+        (1-based, per process) lands with reward=+inf. Runs on the packed
+        wire block just before add_packed, so the poisoned row takes the
+        REAL ingest path into replay — the bad-row sample detector and
+        its source attribution are exercised end to end."""
+        base = ingested_rows[0]
+        m = len(packed)
+        reward_col = spec.obs_dim + spec.act_dim
+        for at in numeric_replay_at:
+            if base < at <= base + m:
+                packed[at - base - 1, reward_col] = np.inf
+                print(
+                    f"[chaos] numeric:replay:inf — poisoned ingested row "
+                    f"{at} (reward=+inf)",
+                    file=sys.stderr, flush=True,
+                )
+        ingested_rows[0] = base + m
+        return packed
+
     def drain() -> int:
         # Ingest rate limiter (config.max_ingest_ratio): when the budget is
         # exhausted, skip draining — transports fill and workers block,
@@ -950,9 +1240,16 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 return 0
         if use_device_replay:
             moved = 0
-            batches = pool.drain_batches(max_rows=max_rows)
-            for batch in batches:
-                device_replay.add_packed(pack_batch_np(batch))
+            track = guard_on and config.guardrail_source_offenses > 0
+            for wid, batch in pool.drain_batches(
+                max_rows=max_rows, with_sources=True
+            ):
+                packed = pack_batch_np(batch)
+                if numeric_replay_at:
+                    packed = _poison_packed(packed)
+                device_replay.add_packed(
+                    packed, source=wid if track else -1
+                )
                 moved += len(batch["reward"])
             return moved
         with replay_lock:
@@ -1032,6 +1329,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         nonlocal last_refresh_t, last_log_t
         learn_steps += chunk
         learn_timer.tick(chunk)
+        if guard_on and _guardrail_monitor():
+            # Rolled back (or numeric-aborted): this chunk's `out` is
+            # moot, the rollback already rebroadcast params, and skipping
+            # the rest — including the per-chunk sync_ship beat — is a
+            # REPLICATED decision (identical health counters everywhere),
+            # so a pod's collective schedule stays aligned.
+            return
         ingest_once(sync_wait=False)
 
         if config.prioritized and not use_device_replay:
@@ -1148,6 +1452,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 **transfer_fields(),
                 # Pod resilience (docs/RESILIENCE.md pod rows).
                 **pod_fields(),
+                # Numerical health (docs/RESILIENCE.md; guardrails.py).
+                **guardrail_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -1378,7 +1684,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             cached_global = 0
             last_budget = -1
             first_dispatch_done = False
-            while not preempt.is_set():
+            while not preempt.is_set() and not numeric_failed[0]:
                 _beat()
                 # Wall-clock fleet supervision (see last_monitor_t note):
                 # every iteration reaches this, including the rate-capped
@@ -1569,7 +1875,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # Skipped under preemption: the contract is "checkpoint and get out";
     # whole CPU eval episodes would hold the exit for seconds.
     _beat()
-    if preempt.is_set():
+    if preempt.is_set() or numeric_failed[0]:
+        # Preemption: "checkpoint and get out". Numeric abort: the params
+        # are presumed poisoned — an eval would score garbage.
         final_return = None
     else:
         eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
@@ -1584,6 +1892,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **phases.snapshot(),
         **transfer_fields(),
         **pod_fields(),
+        **guardrail_fields(),
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the
@@ -1604,8 +1913,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         # documented exit (76 vs 75) — report exactly one of the two.
         "preempted": preempt.is_set() and pod_lost[0] is None,
         "pod_degraded": pod_lost[0] is not None,
+        # Numeric-health abort (EXIT_NUMERIC=77): guardrails exhausted the
+        # rollback budget or had nothing to restore.
+        "numeric_failed": numeric_failed[0],
         **recovery_fields(),
         **pod_fields(),
+        **guardrail_fields(),
     }
 
 
@@ -1661,6 +1974,12 @@ def main(argv=None) -> None:
     print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})
     if summary.get("pod_degraded"):
         pod_degraded_exit()
+    if summary.get("numeric_failed"):
+        # Documented numeric-health abort: the guardrails could not repair
+        # a sustained divergence. Distinct from 75/76 (those are "relaunch
+        # and resume"): a driver should inspect the guardrail_* counters
+        # before pouring more compute onto a diverging config.
+        sys.exit(EXIT_NUMERIC)
     if summary.get("preempted"):
         # The documented "preempted, resumable" exit — a driver retries
         # the run with the same checkpoint_dir instead of diagnosing it.

@@ -21,17 +21,21 @@
 #   KEYS       comma-separated gate keys; '-' prefix = lower-is-better
 #              (default: value — the headline learner-steps/sec ratio —
 #              plus the transfer-scheduler latency pins: ingest_ship_ms
-#              and the transfer p95 tails, docs/TRANSFER.md. Keys the
-#              BASELINE lacks are SKIPped, so old BENCH_r*.json baselines
-#              gate on value alone and the latency pins arm automatically
-#              once a post-scheduler bench becomes the baseline; a key
-#              the CANDIDATE drops while the baseline has it FAILS.)
+#              and the transfer p95 tails, docs/TRANSFER.md; plus the
+#              numerical-health pin -guardrail_rollbacks, which arms once
+#              a BENCH_GUARDRAILS=1 bench becomes the baseline — a
+#              candidate that skips updates or rolls back where the
+#              baseline did not is a correctness regression, not noise.
+#              Keys the BASELINE lacks are SKIPped, so old BENCH_r*.json
+#              baselines gate on value alone and the new pins arm
+#              automatically once a newer bench becomes the baseline; a
+#              key the CANDIDATE drops while the baseline has it FAILS.)
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
 candidate="${1:?usage: ci_gate.sh <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).

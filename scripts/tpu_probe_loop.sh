@@ -25,14 +25,18 @@ RUN_ON_RECOVERY="${RUN_ON_RECOVERY:-0}"
 # (launch under setsid) to runs/cpu_jobs.pids.
 PIDFILE="runs/cpu_jobs.pids"
 cpu_jobs() {  # cpu_jobs <signal>
-  # Guard against PGID recycling: only signal a group whose leader still
-  # looks like one of OUR jobs (repo scripts / package trainers). A
-  # stale entry whose PGID the kernel reused for something unrelated
-  # must not get frozen for a whole runbook invocation.
+  # Guard against PGID recycling: only signal a group that still contains
+  # one of OUR jobs (repo scripts / package trainers). A stale entry
+  # whose PGID the kernel reused for something unrelated must not get
+  # frozen for a whole runbook invocation. Match ANY member of the group
+  # (-g), not just the leader (-p): a setsid leader that exited while
+  # its python children live on would otherwise silently skip the group
+  # and the CPU contention this mechanism exists to stop would persist
+  # through the recovery window.
   [ -f "$PIDFILE" ] || return 0
   while read -r pg; do
     [ -n "$pg" ] || continue
-    ps -o args= -p "$pg" 2>/dev/null \
+    ps -o args= -g "$pg" 2>/dev/null \
       | grep -q 'scripts/\|distributed_ddpg_tpu' || continue
     kill "-$1" "-$pg" 2>/dev/null
   done < "$PIDFILE"

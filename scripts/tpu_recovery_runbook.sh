@@ -230,9 +230,14 @@ stage sweepfree  1200 'SWEEP_DONE' bash scripts/staleness_sweep.sh free
 # ladder23 must show the FINAL rung's record measured on the chip;
 # tputests must show actual passes — an all-skip pytest run exits 0 (the
 # tpu fixture skips in seconds when the tunnel flapped after the
-# alive_fresh pre-check), and that must not retire the stage.
+# alive_fresh pre-check), and that must not retire the stage. The
+# negative patterns anchor to the pytest SUMMARY tokens ('N failed' /
+# 'N error(s)'): a bare '! error' substring would let any benign "error"
+# text (warnings summary, deprecation notes, test names echoed in -q
+# output) block retirement of a fully-green run and accrue strikes
+# toward GIVE-UP.
 stage ladder23   2400 '"rung": 3'"%%$TPU" python -m distributed_ddpg_tpu.ladder --rungs=2,3 --log_dir=runs
-stage tputests   1500 ' passed%%! failed%%! error' python -m pytest tests/test_tpu.py -q
+stage tputests   1500 ' passed%%![0-9] failed%%![0-9] error' python -m pytest tests/test_tpu.py -q
 note "recovery runbook done (markers: $(ls "$DONE_DIR" 2>/dev/null | tr '\n' ' '))"
 for s in $STAGES; do
   [ -f "$DONE_DIR/$s.done" ] || [ -f "$DONE_DIR/$s.gave_up" ] || exit 1

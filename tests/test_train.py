@@ -259,15 +259,27 @@ def test_checkpoint_retention_prunes_old_steps(tmp_path):
 def test_checkpoint_retention_protects_fresh_save_from_stale_dirs(tmp_path):
     """A fresh run reusing a directory with HIGHER-numbered stale
     checkpoints (the --resume=false reuse workflow) must never prune the
-    checkpoint it just wrote — numeric sorting alone would."""
+    checkpoint it just wrote — numeric sorting alone would. And the stale
+    higher-numbered dirs themselves must GO (loudly): left in place they
+    would permanently occupy the keep-N retention slots (every later save
+    deleting the run's own previous checkpoint) and keep
+    latest_step()/resume pointing at another run's state."""
     cfg = DDPGConfig(actor_hidden=(16, 16), critic_hidden=(16, 16))
     state = init_train_state(cfg, 4, 2, seed=0)
     for stale in (100_000, 110_000, 120_000):
         ckpt_lib.save(str(tmp_path), stale, state, None, cfg, keep=0)
     ckpt_lib.save(str(tmp_path), 10_000, state, None, cfg, keep=3)
     kept = {p for p in os.listdir(tmp_path) if p.startswith("step_")}
-    assert "step_10000" in kept, "the just-written checkpoint was pruned"
-    assert len(kept) == 3
+    assert kept == {"step_10000"}, (
+        f"stale higher-numbered checkpoints must be pruned: {kept}"
+    )
+    # Resume now finds THIS run's state, and the next saves rebuild the
+    # keep-N redundancy below it.
+    assert ckpt_lib.latest_step(str(tmp_path)) == 10_000
+    ckpt_lib.save(str(tmp_path), 20_000, state, None, cfg, keep=3)
+    ckpt_lib.save(str(tmp_path), 30_000, state, None, cfg, keep=3)
+    kept = sorted(p for p in os.listdir(tmp_path) if p.startswith("step_"))
+    assert kept == ["step_10000", "step_20000", "step_30000"]
 
 
 @pytest.mark.slow
