@@ -28,7 +28,9 @@ platform (smoke-testing); BENCH_SECONDS scales measurement length;
 BENCH_SCALING=0 skips the virtual-device scaling curve; BENCH_CHUNK
 overrides the learner chunk length for the accelerator phase;
 BENCH_INGEST_ASYNC=0 / BENCH_INGEST_COALESCE=1 fall back to the seed's
-serial inline replay ingest for A/B runs (docs/INGEST.md).
+serial inline replay ingest for A/B runs (docs/INGEST.md); BENCH_SERVE=1
+adds the serve-path measurement (served throughput + p50/p95 with a
+per-worker act() A/B at each client count — docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -543,6 +545,48 @@ def phase_study() -> dict:
     return {"study": points, "study_platform": measured_platform}
 
 
+def phase_serve() -> dict:
+    """Serve-path measurement (BENCH_SERVE=1; docs/SERVING.md): served
+    throughput + latency tails from the dynamic batcher at the production
+    net shapes, with the per-worker local act() A/B at each client count
+    — the serving analogue of the virtual-device scaling curves. CPU-only
+    (the serving stack's dispatch machinery is host-side either way), so
+    it can never wedge on a dead tunnel. The headline serve_p95_ms /
+    serve_queue_depth_p95 land at the top level of the bench JSON, arming
+    scripts/ci_gate.sh's lower-is-better serve keys once a serve-carrying
+    BENCH becomes the baseline."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_ddpg_tpu.tools.serve_bench import run_serve_bench
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "2"))
+    curve = {}
+    for n in (1, 2, 4, 8):
+        r = run_serve_bench(
+            clients=n, duration_s=seconds, obs_dim=OBS_DIM, act_dim=ACT_DIM,
+            hidden=HIDDEN, max_batch=32, max_latency_ms=5.0,
+        )
+        curve[str(n)] = {
+            "served_rps": r["served_rps"],
+            "local_act_rps": r["local_act_rps"],      # the A/B row
+            "served_vs_local": r.get("served_vs_local", 0.0),
+            "serve_p50_ms": r["serve_p50_ms"],
+            "serve_p95_ms": r["serve_p95_ms"],
+            "serve_fill_mean": r["serve_fill_mean"],
+            "serve_queue_depth_p95": r["serve_queue_depth_p95"],
+            "client_sheds": r["client_sheds"],
+        }
+    head = curve[str(max(int(k) for k in curve))]
+    return {
+        "serve_scaling": curve,
+        "serve_rps": head["served_rps"],
+        "serve_p50_ms": head["serve_p50_ms"],
+        "serve_p95_ms": head["serve_p95_ms"],
+        "serve_queue_depth_p95": head["serve_queue_depth_p95"],
+    }
+
+
 _PHASES = {
     "native": phase_native,
     "probe": phase_probe,
@@ -550,6 +594,7 @@ _PHASES = {
     "ingest": phase_ingest,
     "scaling": phase_scaling,
     "study": phase_study,
+    "serve": phase_serve,
 }
 
 
@@ -837,6 +882,20 @@ def main() -> int:
         study, err = _run_phase("study", accel_env, timeout=study_timeout)
         if study:
             result.update(study)
+        else:
+            errors.append(err)
+
+    # Serve-path measurement (BENCH_SERVE=1; docs/SERVING.md): CPU-only
+    # and tunnel-independent, so it runs after the accelerator capture.
+    # The top-level serve_p95_ms / serve_queue_depth_p95 keys arm
+    # ci_gate.sh's serve pins once this bench becomes the baseline.
+    if os.environ.get("BENCH_SERVE", "0") == "1" and not study_only:
+        note("serve bench phase")
+        serve_res, err = _run_phase(
+            "serve", {"JAX_PLATFORMS": "cpu"}, timeout=600
+        )
+        if serve_res:
+            result.update(serve_res)
         else:
             errors.append(err)
 

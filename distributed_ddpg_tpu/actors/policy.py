@@ -47,6 +47,25 @@ def layout_size(layout: Layout) -> int:
     return sum(int(np.prod(w)) + int(np.prod(b)) for w, b in layout)
 
 
+def seqlock_snapshot(shared, version, out: np.ndarray, seen_version: int):
+    """One seqlock read attempt of the pool's broadcast buffer
+    (ActorPool.broadcast writes it: version odd while the flat array is
+    mid-write, even when consistent). Copies into `out` and returns the
+    new version when a CONSISTENT, not-yet-seen snapshot was read; returns
+    None otherwise (nothing new, write in progress, or torn — the caller
+    keeps acting on its previous params). Shared by the worker's local
+    mirror (worker.py) and the inference server (serve/server.py) so the
+    subtle discard discipline lives in exactly one place."""
+    v = version.value
+    if v == seen_version or v % 2 == 1:
+        return None
+    flat = np.frombuffer(shared, dtype=np.float32)
+    out[:] = flat[: out.size]
+    if version.value != v:
+        return None
+    return v
+
+
 def flatten_params(params, out: np.ndarray | None = None) -> np.ndarray:
     """Flatten a (tuple of {'w','b'}) tree into one f32 vector (w then b,
     layer order). Writes into `out` when given (the shared-memory buffer)."""

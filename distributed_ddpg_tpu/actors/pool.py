@@ -119,6 +119,26 @@ class ActorPool:
                         buf, config.shm_ring_rows, self.row_width, init=True
                     )
                 )
+        # --- served-actor transport (serve/; docs/SERVING.md) ---
+        # config.serve_actors: workers request actions from the learner
+        # process's InferenceServer over ONE bounded shared request queue
+        # (obs rows are tiny — pickling cost is irrelevant at act()
+        # granularity) and each worker gets a private response queue so
+        # replies never fan out. The counter array records local-act
+        # fallbacks (timeout/overload/dispatch failure — the degraded
+        # mode the serve chaos tests pin); the pool only ever READS it.
+        self.serving = bool(config.serve_actors)
+        self._serve_req = None
+        self._serve_resp: List = []
+        self._serve_fallbacks = None
+        if self.serving:
+            self._serve_req = self._ctx.Queue(maxsize=config.serve_queue)
+            self._serve_resp = [
+                self._ctx.Queue(maxsize=8) for _ in range(self.num_actors)
+            ]
+            self._serve_fallbacks = self._ctx.Array(
+                "l", self.num_actors, lock=False
+            )
         self._episodes = self._ctx.Queue(maxsize=16 * self.num_actors)
         self._heartbeat = self._ctx.Array("d", self.num_actors, lock=False)
         self._stop = self._ctx.Value("b", 0)
@@ -221,6 +241,15 @@ class ActorPool:
                 log_std_max=self.config.sac_log_std_max,
                 warmup_uniform=self.warmup_budget_per_worker(),
                 episode_queue=self._episodes,
+                # Served-actor transport (config.serve_actors; None = the
+                # default per-worker act() path).
+                serve_request_queue=self._serve_req,
+                serve_response_queue=(
+                    self._serve_resp[worker_id] if self.serving else None
+                ),
+                serve_fallbacks=self._serve_fallbacks,
+                serve_timeout_s=self.config.serve_timeout_s,
+                serve_fallback_s=self.config.serve_fallback_s,
                 # Flight recorder: workers are separate processes, so each
                 # keeps its OWN ring and exports trace_actor<k>.json on
                 # clean exit; Perfetto merges the files by pid.
@@ -265,6 +294,28 @@ class ActorPool:
         for p in self._procs:
             if p is not None and p.is_alive():
                 p.terminate()
+
+    # --- serving surface (serve/; docs/SERVING.md) ---
+
+    def serve_channels(self):
+        """(request_queue, response_queues) for the learner process's
+        ServeFront. Only meaningful when config.serve_actors built them."""
+        return self._serve_req, self._serve_resp
+
+    def param_source(self):
+        """(shared flat-param array, seqlock version) — the broadcast
+        buffer the workers poll; the InferenceServer refreshes its policy
+        from the same source, so serving needs no second param path."""
+        return self._shared, self._version
+
+    def serve_counters(self) -> Dict[str, int]:
+        """Served-client fallback total for the serve_* metrics family:
+        how many times workers degraded to their local act() path."""
+        if self._serve_fallbacks is None:
+            return {}
+        return {
+            "serve_client_fallbacks": int(sum(self._serve_fallbacks)),
+        }
 
     # --- param broadcast (learner -> workers) ---
 

@@ -55,6 +55,11 @@ def run_worker(
     episode_queue=None,     # optional mp.Queue for (worker_id, return, length)
     parent_pid: int = 0,    # pool process pid, captured at spawn time
     trace_dir: str = "",    # flight-recorder export dir ("" = off)
+    serve_request_queue=None,   # served-actor transport (config.serve_actors):
+    serve_response_queue=None,  # shared req queue + this worker's resp queue
+    serve_fallbacks=None,       # mp.Array('l'): local-act fallback counters
+    serve_timeout_s: float = 1.0,
+    serve_fallback_s: float = 5.0,
 ) -> None:
     # Workers are CPU-only by construction; make BLAS behave in many procs.
     os.environ.setdefault("OMP_NUM_THREADS", "1")
@@ -66,7 +71,11 @@ def run_worker(
     # respawn before the worker can possibly meet it.
 
     from distributed_ddpg_tpu import trace
-    from distributed_ddpg_tpu.actors.policy import NumpyPolicy, encode_version
+    from distributed_ddpg_tpu.actors.policy import (
+        NumpyPolicy,
+        encode_version,
+        seqlock_snapshot,
+    )
     from distributed_ddpg_tpu.envs import make
     from distributed_ddpg_tpu.ops.noise import OUNoise
     from distributed_ddpg_tpu.replay.nstep import NStepAccumulator
@@ -122,16 +131,13 @@ def run_worker(
     carry = None  # rows the ring had no room for on the last flush
 
     def maybe_refresh():
-        """Seqlock read (see ActorPool.broadcast): snapshot to scratch while
-        the version is even, and install into the live policy only if the
-        version did not move during the copy — a torn snapshot is discarded
-        and the previous consistent params keep acting until the next step."""
+        """Seqlock read (policy.seqlock_snapshot; see ActorPool.broadcast):
+        a torn or mid-write snapshot is discarded and the previous
+        consistent params keep acting until the next step."""
         nonlocal seen_version
-        v = param_version.value
-        if v == seen_version or v % 2 == 1:
-            return
-        flat_scratch[:] = flat_view
-        if param_version.value == v:
+        v = seqlock_snapshot(shared_params, param_version, flat_scratch,
+                             seen_version)
+        if v is not None:
             policy.load_flat(flat_scratch)
             seen_version = v
 
@@ -206,6 +212,70 @@ def run_worker(
             except queue_mod.Full:
                 pass
         pending.clear()
+
+    # --- served acting (serve/; docs/SERVING.md) ---
+    # With the serve transport attached, mu(s) comes from the learner
+    # process's InferenceServer (dynamic batching across the fleet); the
+    # local policy mirror stays loaded as the FALLBACK — any failure to
+    # get a served action (queue full, timeout, dispatch error) degrades
+    # to it for serve_fallback_s. The failure contract: a stalled or dead
+    # serving stack costs latency, never a deadlock (chaos tests pin it).
+    import queue as serve_queue_mod
+
+    # Request ids start at a per-incarnation random 48-bit offset, and any
+    # replies already sitting in the response queue are drained: the pool
+    # reuses the SAME response queue across respawns of this slot, so a
+    # late reply addressed to a dead incarnation must never collide with a
+    # fresh incarnation's rid and deliver an action computed for a
+    # different observation.
+    serve_rid = int.from_bytes(os.urandom(6), "little")
+    serve_down_until = 0.0
+    if serve_response_queue is not None:
+        while True:
+            try:
+                serve_response_queue.get_nowait()
+            except Exception:
+                break
+
+    def _serve_degrade() -> None:
+        nonlocal serve_down_until
+        serve_down_until = time.time() + serve_fallback_s
+        if serve_fallbacks is not None:
+            serve_fallbacks[worker_id] += 1
+
+    def served_mu(o: np.ndarray) -> np.ndarray:
+        """One served action request, bounded by serve_timeout_s; the
+        local mirror answers whenever the served path cannot."""
+        nonlocal serve_rid
+        if time.time() < serve_down_until:
+            return policy(o)[0]
+        serve_rid += 1
+        try:
+            serve_request_queue.put_nowait(
+                (worker_id, serve_rid, np.asarray(o, np.float32))
+            )
+        except serve_queue_mod.Full:
+            _serve_degrade()
+            return policy(o)[0]
+        deadline = time.time() + serve_timeout_s
+        while time.time() < deadline and not stop_flag.value:
+            if parent_pid and os.getppid() != parent_pid:
+                return policy(o)[0]  # orphaned: server is gone
+            try:
+                rid, action = serve_response_queue.get(timeout=0.05)
+            except serve_queue_mod.Empty:
+                # Keep the heartbeat warm: a served wait is bounded and
+                # healthy, not a silent worker.
+                heartbeat[worker_id] = time.time()
+                continue
+            if rid != serve_rid:
+                continue  # stale reply from a request we already gave up on
+            if action is None:
+                _serve_degrade()  # server shed or failed this request
+                return policy(o)[0]
+            return np.asarray(action, np.float32)
+        _serve_degrade()
+        return policy(o)[0]
 
     # --- scripted faults (faults.py; see module docstring) ---
     faults = sorted(fault_specs, key=lambda t: t[1])
@@ -282,9 +352,12 @@ def run_worker(
                 np.float32
             )
         else:
-            action = policy(obs)[0] + noise() * np.asarray(
-                action_scale, np.float32
+            mu = (
+                served_mu(obs)
+                if serve_request_queue is not None
+                else policy(obs)[0]
             )
+            action = mu + noise() * np.asarray(action_scale, np.float32)
         action = np.clip(action, action_low, action_high).astype(np.float32)
         next_obs, reward, terminated, truncated, _ = env.step(action)
         done = terminated  # truncation bootstraps: discount stays gamma^n

@@ -153,6 +153,40 @@ class DDPGConfig:
     # the previous beat's enqueue. No effect single-process.
     sync_ship_background: bool = True
 
+    # --- batched policy-inference service (serve/; docs/SERVING.md) ---
+    # Serve actor workers from one InferenceServer instead of each worker
+    # running its own private act(): workers send observations over a
+    # bounded mp queue, a dynamic batcher dispatches at serve_max_batch OR
+    # serve_max_latency_ms (whichever fires first — TorchBeast's knobs,
+    # PAPERS.md arXiv 1910.03552), and actions flow back per worker. Off
+    # by default: the per-worker act() path stays the default AND the
+    # parity oracle (served actions are bit-identical to it under the
+    # numpy serve backend — tests/test_serve.py). Workers that cannot get
+    # a served action (overload, stall, dispatch failure) DEGRADE to their
+    # local policy mirror for serve_fallback_s instead of blocking — a
+    # broken serving stack costs latency, never a deadlock.
+    serve_actors: bool = False
+    # Dispatch triggers: a collected batch goes out when it reaches
+    # serve_max_batch rows or when its oldest request has waited
+    # serve_max_latency_ms, whichever comes first.
+    serve_max_batch: int = 32
+    serve_max_latency_ms: float = 5.0
+    # Bounded request queue: submissions past this raise typed
+    # ServeOverload (shed + degrade, never unbounded buffering).
+    serve_queue: int = 1024
+    # Served-client deadline: a worker waits this long for its action
+    # before falling back to the local act() path...
+    serve_timeout_s: float = 1.0
+    # ...and stays on the local path this long before trying the server
+    # again (degraded-mode cooldown; counted in serve_client_fallbacks).
+    serve_fallback_s: float = 5.0
+    # Serve compute backend: "numpy" = the bit-identical parity oracle
+    # (row-wise NumpyPolicy — same kernels as the per-worker act());
+    # "jax" = device-resident params, one jitted apply over batches padded
+    # to the fixed (serve_max_batch, obs_dim) shape (float-tolerance
+    # parity, like the learner itself).
+    serve_backend: str = "numpy"
+
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15
     ou_sigma: float = 0.2
@@ -609,6 +643,42 @@ class DDPGConfig:
             )
         if self.param_refresh_interval_s < 0:
             raise ValueError("param_refresh_interval_s must be >= 0")
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_max_latency_ms < 0:
+            raise ValueError("serve_max_latency_ms must be >= 0")
+        if self.serve_queue < 1:
+            raise ValueError("serve_queue must be >= 1")
+        if self.serve_timeout_s <= 0:
+            raise ValueError("serve_timeout_s must be > 0")
+        if self.serve_fallback_s < 0:
+            raise ValueError("serve_fallback_s must be >= 0")
+        if self.serve_backend not in ("numpy", "jax"):
+            raise ValueError(
+                f"serve_backend must be 'numpy' or 'jax', got "
+                f"{self.serve_backend!r}"
+            )
+        if self.serve_actors:
+            if self.backend != "jax_tpu":
+                raise ValueError(
+                    "serve_actors serves the actor POOL (jax_tpu backend); "
+                    "the native/ondevice backends have no worker fleet to "
+                    "serve"
+                )
+            if self.strict_sync:
+                raise ValueError(
+                    "serve_actors is incompatible with strict_sync: batch "
+                    "composition and dispatch timing are wall-clock-driven, "
+                    "which breaks the bit-identical-two-runs contract"
+                )
+            if self.sac:
+                raise ValueError(
+                    "serve_actors serves the deterministic head mu(s); SAC "
+                    "workers explore by SAMPLING their tanh-Gaussian policy "
+                    "with a local RNG, which a shared server cannot "
+                    "replicate per client — run SAC on the per-worker "
+                    "act() path"
+                )
         # Fail fast on fault-grammar typos: a bad spec must die at config
         # parse, not hours later when the fault was scheduled to fire.
         from distributed_ddpg_tpu.faults import FaultPlan

@@ -18,7 +18,7 @@ Grammar (';'-separated specs):
 
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
     component := worker | pool | shipper | prefetch | ckpt | transfer | pod
-                 | numeric
+                 | numeric | serve
     kind      := crash | crashloop | hang | stall | slow | ioerror | kill
                  | nan | inf | spike
 
@@ -74,6 +74,14 @@ Fault semantics by component:
     numeric:loss:spike@K     the K-th guarded learner step sees rewards
                              scaled 1e6 (finite, absurd) — the EWMA z-score
                              anomaly detector's territory
+    serve:batcher:stall@K~S  the K-th inference-batch dispatch sleeps S
+                             before collecting (serve/batcher.py) — served
+                             clients must time out and DEGRADE to their
+                             local act() path instead of deadlocking
+                             (docs/SERVING.md failure contract)
+    serve:dispatch:crash@K   the K-th inference-batch apply raises: every
+                             request in that batch fails typed, clients
+                             fall back locally, the batcher survives
 
 Numeric `at` ordinals count GUARDED learner steps on a monotonic clock
 (guardrails.GuardState.total) that is deliberately NOT rolled back by the
@@ -102,7 +110,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
-              "pod", "numeric")
+              "pod", "numeric", "serve")
 KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill",
          "nan", "inf", "spike")
 
@@ -119,6 +127,13 @@ _POD_KINDS = ("kill", "hang")
 # Numeric faults are target->kind pairs (each target poisons one specific
 # detector of the guardrails probe): grad->nan, replay->inf, loss->spike.
 _NUMERIC_PAIRS = {"grad": "nan", "replay": "inf", "loss": "spike"}
+# Serve faults target one of the two batcher fault points: the collection
+# path (stall/slow — delayed responses, the client-timeout fallback path)
+# or the batch apply (crash/slow — a failed batch fails typed).
+_SERVE_KINDS = {
+    "batcher": ("stall", "hang", "slow"),
+    "dispatch": ("crash", "slow"),
+}
 
 
 class InjectedFault(OSError):
@@ -150,7 +165,7 @@ def _default_duration(kind: str, rng: random.Random,
     host-site timeout."""
     if kind == "slow":
         return round(rng.uniform(0.05, 0.25), 3)
-    if kind == "hang":
+    if kind in ("hang", "stall"):
         if component == "pod":
             return 3600.0
         return round(rng.uniform(2.0, 5.0), 3)
@@ -314,6 +329,16 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
                 f"numeric:{target} takes kind {_NUMERIC_PAIRS[target]!r} "
                 f"(got {kind!r}) — grad:nan, replay:inf, loss:spike"
             )
+    elif component == "serve":
+        if target not in _SERVE_KINDS:
+            raise bad(
+                f"serve target must be one of {tuple(_SERVE_KINDS)}"
+            )
+        if kind not in _SERVE_KINDS[target]:
+            raise bad(
+                f"serve:{target} takes kind in {_SERVE_KINDS[target]} "
+                f"(got {kind!r})"
+            )
     else:
         if kind not in _SITE_KINDS:
             raise bad(f"kind {kind!r} does not apply to host sites")
@@ -353,7 +378,7 @@ class FaultSite:
             due = self._by_at.get(self._count, ())
         for s in due:
             self.fired.append(s.describe())
-            if s.kind in ("slow", "hang"):
+            if s.kind in ("slow", "hang", "stall"):
                 time.sleep(s.duration_s)
             elif s.kind == "kill":
                 # Pod-scoped process death (pod:<proc>:kill@beat): SIGKILL

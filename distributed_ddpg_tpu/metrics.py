@@ -300,7 +300,7 @@ class TransferStats:
     # d2h runs inline on the caller thread (scheduler.run_inline) but is
     # accounted identically; it is excluded from transfer_dispatches,
     # which counts the SCHEDULED classes the dispatch thread executed.
-    SCHEDULED = ("lockstep", "ingest", "prefetch")
+    SCHEDULED = ("lockstep", "ingest", "prefetch", "serve")
     CLASSES = SCHEDULED + ("d2h",)
 
     def __init__(self, seed: int = 0):
@@ -513,6 +513,113 @@ class GuardrailStats:
             "guardrail_lr_cooldowns": self.lr_cooldowns,
             "guardrail_source_quarantines": self.source_quarantines,
         }
+
+
+class ServeStats:
+    """Thread-safe counters for the batched policy-inference service
+    (serve/; docs/SERVING.md) — the `serve_*` family every train/final
+    JSONL record carries when serving is armed, and the digest
+    tools.serve_bench / bench.py BENCH_SERVE emit.
+
+    COUNTERS are cumulative (requests/batches/overloads/errors/refreshes:
+    the run's serving history; a nonzero overload anywhere matters even if
+    the last interval was quiet). TAILS are interval-scoped: the latency,
+    batch-fill, and queue-depth reservoirs reset at snapshot so each
+    record's p50/p95 describes its own window — the same PhaseTimers
+    reservoir discipline (deterministic seeds) the t_* phases use:
+
+      serve_requests        requests accepted by the batcher (cumulative)
+      serve_batches         batches dispatched (cumulative)
+      serve_overloads       submissions rejected by the bounded queue —
+                            typed ServeOverload backpressure (cumulative)
+      serve_errors          batch dispatches that failed; every request in
+                            the batch got a typed error (cumulative)
+      serve_param_refreshes params reloaded from the broadcast buffer
+                            (cumulative)
+      serve_fill_mean       rows per dispatched batch / max_batch over the
+                            whole run (1.0 = every batch full)
+      serve_fill_p50/p95    interval batch-fill fraction tails
+      serve_p50_ms/p95_ms/max_ms
+                            interval request latency tails, enqueue ->
+                            response delivered (the ci_gate -serve_p95_ms
+                            key pins the p95)
+      serve_queue_depth     request-queue depth at snapshot (gauge)
+      serve_queue_depth_p95 interval p95 of the depth seen at each submit
+                            (the ci_gate -serve_queue_depth_p95 key)
+    """
+
+    def __init__(self, seed: int = 0, max_batch: int = 1):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self.max_batch = max(1, int(max_batch))
+        self.requests = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.overloads = 0
+        self.errors = 0
+        self.refreshes = 0
+        self._reset_reservoirs()
+
+    def _reset_reservoirs(self) -> None:
+        def res(name: str) -> _Reservoir:
+            return _Reservoir(
+                PhaseTimers.RESERVOIR_K,
+                (zlib.crc32(name.encode()) ^ self._seed) & 0x7FFFFFFF,
+            )
+
+        self._lat = res("serve_latency")
+        self._fill = res("serve_fill")
+        self._depth = res("serve_depth")
+
+    def record_request(self, queue_depth: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self._depth.add(float(queue_depth))
+
+    def record_batch(self, rows: int, latencies_s) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += int(rows)
+            self._fill.add(rows / self.max_batch)
+            for lat in latencies_s:
+                self._lat.add(lat)
+
+    def record_overload(self) -> None:
+        with self._lock:
+            self.overloads += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_refresh(self) -> None:
+        with self._lock:
+            self.refreshes += 1
+
+    def snapshot(self, queue_depth: int = 0, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            out = {
+                "serve_requests": self.requests,
+                "serve_batches": self.batches,
+                "serve_overloads": self.overloads,
+                "serve_errors": self.errors,
+                "serve_param_refreshes": self.refreshes,
+                "serve_fill_mean": (
+                    round(self.batch_rows / (self.batches * self.max_batch), 4)
+                    if self.batches
+                    else 0.0
+                ),
+                "serve_fill_p50": round(self._fill.percentile(0.50), 4),
+                "serve_fill_p95": round(self._fill.percentile(0.95), 4),
+                "serve_p50_ms": round(1000.0 * self._lat.percentile(0.50), 3),
+                "serve_p95_ms": round(1000.0 * self._lat.percentile(0.95), 3),
+                "serve_max_ms": round(1000.0 * self._lat.max, 3),
+                "serve_queue_depth": int(queue_depth),
+                "serve_queue_depth_p95": round(self._depth.percentile(0.95), 3),
+            }
+            if reset:
+                self._reset_reservoirs()
+        return out
 
 
 class Timer:

@@ -1,0 +1,40 @@
+"""Batched policy-inference service (docs/SERVING.md).
+
+The serving subsystem that turns the repo from "training job" into
+"training + serving system" (ROADMAP north star): an `InferenceServer`
+owns the policy params (refreshed from the learner through the existing
+pool-broadcast buffer), a dynamic `Batcher` collects client observations
+and dispatches at `max_batch` OR `max_latency_ms` — whichever fires
+first (TorchBeast's knobs, PAPERS.md arXiv 1910.03552) — and clients
+attach in-process (`ServeClient`; tools.serve_bench) or across processes
+(actor workers through `ServeFront`, behind config.serve_actors).
+
+  - batcher.Batcher: deadline dispatch, bounded queue with typed
+    `ServeOverload` backpressure, flush-on-shutdown.
+  - server.InferenceServer: params + compute (numpy parity oracle / jax
+    device path), transfer-scheduler `serve` class routing, `serve_*`
+    observability (metrics.ServeStats).
+  - client.ServeClient / client.ServeFront: the blocking local handle and
+    the served-actor mp-queue front.
+"""
+
+from distributed_ddpg_tpu.serve.batcher import (
+    Batcher,
+    ServeClosed,
+    ServeDispatchError,
+    ServeOverload,
+    ServeTimeout,
+)
+from distributed_ddpg_tpu.serve.client import ServeClient, ServeFront
+from distributed_ddpg_tpu.serve.server import InferenceServer
+
+__all__ = [
+    "Batcher",
+    "InferenceServer",
+    "ServeClient",
+    "ServeClosed",
+    "ServeDispatchError",
+    "ServeFront",
+    "ServeOverload",
+    "ServeTimeout",
+]

@@ -1,0 +1,115 @@
+"""Serve clients: the in-process blocking handle and the served-actor
+front (docs/SERVING.md).
+
+`ServeClient` is the local RPC surface: `act(obs)` blocks until the
+server's batcher delivers this request's action row (or raises typed —
+ServeOverload / ServeClosed when the request was shed, ServeDispatchError
+when its batch failed, ServeTimeout when the client's own deadline
+passed). `tools.serve_bench` drives load through it without Gym.
+
+`ServeFront` bridges the actor POOL's multiprocessing transport onto the
+in-process batcher: worker processes put `(worker_id, request_id, obs)`
+on one shared bounded request queue (actors/pool.py builds it when
+config.serve_actors), the front drains it into `Batcher.submit`, and each
+completion callback pushes `(request_id, action | None)` onto that
+worker's private response queue. `None` tells the worker "the service
+could not serve this request" — it degrades to its local act() path
+(actors/worker.py `served_mu`), which is the whole failure contract:
+a stalled or crashed serving stack costs latency, never a deadlock.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_ddpg_tpu.serve.batcher import (
+    ServeClosed,
+    ServeOverload,
+    ServeTimeout,
+)
+
+
+class ServeClient:
+    """Blocking in-process handle over one InferenceServer."""
+
+    def __init__(self, server, timeout_s: float = 1.0):
+        self._server = server
+        self.timeout_s = float(timeout_s)
+
+    def act(self, obs, timeout_s: Optional[float] = None) -> np.ndarray:
+        """One observation row in, one action row out. Raises typed on
+        shed/failed/late requests (module docstring)."""
+        done = threading.Event()
+        box: list = []
+
+        def _cb(result):
+            box.append(result)
+            done.set()
+
+        self._server.batcher.submit(np.asarray(obs, np.float32), _cb)
+        if not done.wait(self.timeout_s if timeout_s is None else timeout_s):
+            raise ServeTimeout(
+                f"no response within {timeout_s or self.timeout_s}s"
+            )
+        result = box[0]
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+
+class ServeFront:
+    """Drain thread: pool request queue -> batcher -> per-worker response
+    queues. Lives in the learner process next to the InferenceServer."""
+
+    def __init__(self, server, request_queue, response_queues):
+        self._server = server
+        self._req = request_queue
+        self._resp = response_queues
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServeFront":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-front"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def _respond(self, wid: int, rid: int, action) -> None:
+        """Best-effort response delivery: a full response queue means the
+        worker already abandoned this request (it bounds its own wait and
+        falls back locally) — dropping the reply is the correct move."""
+        try:
+            self._resp[wid].put_nowait((rid, action))
+        except (queue_mod.Full, ValueError, OSError):
+            pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                wid, rid, obs = self._req.get(timeout=0.05)
+            except queue_mod.Empty:
+                continue
+            except (OSError, ValueError, EOFError):
+                return  # transport torn down under us: pool is stopping
+
+            def _cb(result, wid=wid, rid=rid):
+                self._respond(
+                    wid, rid,
+                    None if isinstance(result, BaseException) else result,
+                )
+
+            try:
+                self._server.batcher.submit(np.asarray(obs, np.float32), _cb)
+            except (ServeOverload, ServeClosed):
+                self._respond(wid, rid, None)

@@ -1,0 +1,220 @@
+"""InferenceServer: one policy, many callers (docs/SERVING.md).
+
+The serving half of the TorchBeast topology (PAPERS.md arXiv 1910.03552):
+the server owns the policy parameters, a dynamic `Batcher` collects client
+observations, and each collected batch is applied in ONE policy evaluation
+— the shape under which inference cost dominates at scale (the CPU-GPU
+architectural-implications study, arXiv 2012.04210).
+
+Two compute backends:
+
+  numpy  (default) The parity oracle: each batch row is evaluated through
+         the SAME NumpyPolicy `(1, obs_dim)` call the per-worker `act()`
+         path runs, so served actions are BIT-IDENTICAL to local actions
+         for the same params (tests/test_serve.py pins it). Row-wise
+         evaluation is deliberate: batched BLAS GEMM is NOT row-wise
+         bit-stable against the single-row kernel (measured ~2e-5
+         divergence at 256-wide hiddens), and the bit-identity contract
+         outranks CPU matmul efficiency — on CPU the batching win is in
+         the dispatch/queueing machinery, not the math.
+  jax    The device-serving path: params live device-resident, each batch
+         is padded to the FIXED (max_batch, obs_dim) shape (one compiled
+         program, no shape churn) and applied with a jitted mirror of
+         models/mlp.actor_apply. Actions match the numpy oracle to float
+         tolerance, not bitwise — same contract as the learner itself.
+
+Param refresh rides the EXISTING pool-broadcast path: the server holds the
+same shared-memory flat buffer + seqlock version the workers poll
+(actors/pool.py `broadcast`), and re-reads it at most once per batch
+dispatch — a torn snapshot is discarded exactly like a worker's
+(actors/worker.py `maybe_refresh`).
+
+Transfer integration (docs/TRANSFER.md): with a TransferScheduler
+attached, every batch apply is submitted as a `serve` work item —
+byte-fair against ingest/prefetch, never ahead of lockstep — so serving
+and training share the host<->device bus under one accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distributed_ddpg_tpu.actors.policy import NumpyPolicy, layout_size
+from distributed_ddpg_tpu.metrics import ServeStats
+from distributed_ddpg_tpu.serve.batcher import Batcher
+
+# One serve dispatch is bounded by the scheduler's worst-case backlog
+# (lockstep beats + ingest super-blocks ahead of it), not by compute.
+_SCHED_TIMEOUT_S = 60.0
+
+
+class InferenceServer:
+    def __init__(
+        self,
+        layout,
+        action_scale,
+        action_offset=0.0,
+        *,
+        max_batch: int = 32,
+        max_latency_s: float = 0.005,
+        max_queue: int = 1024,
+        backend: str = "numpy",
+        param_source: Optional[Tuple] = None,  # (shared f32 array, version)
+        scheduler=None,
+        stats: Optional[ServeStats] = None,
+        seed: int = 0,
+        fault_batcher=None,
+        fault_dispatch=None,
+    ):
+        if backend not in ("numpy", "jax"):
+            raise ValueError(f"serve backend must be 'numpy' or 'jax', got {backend!r}")
+        self.backend = backend
+        self.layout = layout
+        self.obs_dim = int(layout[0][0][0])  # first layer w is (obs, hidden)
+        self.act_dim = int(layout[-1][0][1])
+        # Deterministic head only: mu(s) (serving SAC's sampling head would
+        # move each client's exploration RNG server-side; config.py forbids
+        # serve_actors with sac).
+        self._policy = NumpyPolicy(layout, action_scale, action_offset)
+        self._param_lock = threading.Lock()
+        self._param_source = param_source
+        self._seen_version = -1
+        self._scratch = np.empty(layout_size(layout), np.float32)
+        self.scheduler = scheduler
+        self.stats = stats or ServeStats(seed=seed, max_batch=max_batch)
+        self._jax_apply = None
+        self._jax_params = None
+        if backend == "jax":
+            self._build_jax_apply()
+        self.batcher = Batcher(
+            self._apply_batch,
+            max_batch=max_batch,
+            max_latency_s=max_latency_s,
+            max_queue=max_queue,
+            stats=self.stats,
+            fault_batcher=fault_batcher,
+            fault_dispatch=fault_dispatch,
+        )
+
+    # --- lifecycle ---
+
+    def start(self) -> "InferenceServer":
+        self.batcher.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Flush-on-shutdown: the batcher drains every accepted request
+        before its thread exits (serve/batcher.py contract)."""
+        self.batcher.close(timeout=timeout)
+
+    def client(self, timeout_s: float = 1.0):
+        from distributed_ddpg_tpu.serve.client import ServeClient
+
+        return ServeClient(self, timeout_s=timeout_s)
+
+    # --- params ---
+
+    def refresh(self, flat: np.ndarray) -> None:
+        """Install params directly from a flat f32 vector (serve_bench,
+        tests; the pool path goes through _maybe_refresh instead)."""
+        with self._param_lock:
+            self._policy.load_flat(np.asarray(flat, np.float32))
+            if self.backend == "jax":
+                self._ship_jax_params()
+        self.stats.record_refresh()
+
+    def _maybe_refresh(self) -> None:
+        """Seqlock read of the pool's broadcast buffer
+        (policy.seqlock_snapshot — the same discard discipline the worker
+        mirror uses). At most one check per batch dispatch — an int
+        compare when nothing changed."""
+        if self._param_source is None:
+            return
+        from distributed_ddpg_tpu.actors.policy import seqlock_snapshot
+
+        shared, version = self._param_source
+        v = seqlock_snapshot(shared, version, self._scratch,
+                             self._seen_version)
+        if v is not None:
+            with self._param_lock:
+                self._policy.load_flat(self._scratch)
+                if self.backend == "jax":
+                    self._ship_jax_params()
+            self._seen_version = v
+            self.stats.record_refresh()
+
+    # --- compute ---
+
+    def _apply_batch(self, obs: np.ndarray) -> np.ndarray:
+        """The Batcher's apply_fn: refresh params, then run the batch —
+        through the transfer scheduler's `serve` class when attached (the
+        obs h2d + apply + action d2h accounted like any other bus user),
+        inline otherwise."""
+        self._maybe_refresh()
+        nbytes = obs.nbytes + obs.shape[0] * self.act_dim * 4
+        if self.scheduler is not None:
+            return self.scheduler.submit(
+                "serve",
+                lambda: self._compute(obs),
+                nbytes=nbytes,
+                label=f"serve_batch_{obs.shape[0]}",
+            ).result(timeout=_SCHED_TIMEOUT_S)
+        return self._compute(obs)
+
+    def _compute(self, obs: np.ndarray) -> np.ndarray:
+        with self._param_lock:
+            if self.backend == "jax":
+                return self._compute_jax(obs)
+            # Row-wise (1, obs_dim) evaluation — the bit-identity parity
+            # contract with the per-worker act() path (module docstring).
+            return np.concatenate([self._policy(row) for row in obs], axis=0)
+
+    def _build_jax_apply(self) -> None:
+        # THE learner's actor head (models/mlp.actor_apply), not a local
+        # mirror: the serve jax backend must track any future change to
+        # the head (activation, mixed-precision handling) automatically.
+        import functools
+
+        import jax
+
+        from distributed_ddpg_tpu.models.mlp import actor_apply
+
+        self._jax_apply = jax.jit(
+            functools.partial(
+                actor_apply,
+                action_scale=self._policy.scale,
+                action_offset=self._policy.offset,
+            )
+        )
+        self._ship_jax_params()
+
+    def _ship_jax_params(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._jax_params = jax.device_put(
+            tuple(
+                {"w": jnp.asarray(l["w"]), "b": jnp.asarray(l["b"])}
+                for l in self._policy.layers
+            )
+        )
+
+    def _compute_jax(self, obs: np.ndarray) -> np.ndarray:
+        n = obs.shape[0]
+        if n < self.batcher.max_batch:
+            # Pad to the ONE compiled shape; padded rows compute garbage
+            # that is sliced away below.
+            padded = np.zeros((self.batcher.max_batch, self.obs_dim), np.float32)
+            padded[:n] = obs
+            obs = padded
+        return np.asarray(self._jax_apply(self._jax_params, obs))[:n]
+
+    # --- observability ---
+
+    def snapshot(self) -> dict:
+        """The serve_* family (metrics.ServeStats) with the live queue
+        depth riding in as a gauge."""
+        return self.stats.snapshot(queue_depth=self.batcher.depth())

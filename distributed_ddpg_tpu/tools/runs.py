@@ -281,6 +281,22 @@ def summarize_run(path: str) -> Dict[str, Any]:
             guardrail[key] = {"last": vals[-1], "max": max(vals)}
     digest["guardrail"] = guardrail
 
+    # Serving digest (serve/; docs/SERVING.md): request/batch counters are
+    # cumulative (report the last = total), latency/fill/depth tails are
+    # interval-scoped (steady + worst interval).
+    serve = {}
+    serve_keys = sorted(
+        {k for r in train + final for k in r if k.startswith("serve_")}
+    )
+    for key in serve_keys:
+        vals = _col(train + final, key)
+        if vals:
+            serve[key] = {
+                "steady": _tail_mean(vals), "max": max(vals),
+                "last": vals[-1],
+            }
+    digest["serve"] = serve
+
     recovery = {}
     for key in RECOVERY_KEYS:
         vals = _col(train + final, key)
@@ -345,6 +361,15 @@ def render_summary(digest: Dict[str, Any]) -> str:
             [
                 [k, v["steady"], v["max"]]
                 for k, v in digest["transfer"].items()
+            ],
+        ))
+    if digest.get("serve"):
+        out.append("\n-- inference serving (docs/SERVING.md)")
+        out.append(render_table(
+            ["field", "steady", "max", "last"],
+            [
+                [k, v["steady"], v["max"], v["last"]]
+                for k, v in digest["serve"].items()
             ],
         ))
     if digest.get("pod"):
@@ -433,6 +458,20 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
             lower_better=(
                 "queue" in key or "_ms" in key or "p95" in key
                 or "fence" in key
+            ))
+    for key in sorted(set(a.get("serve", {})) | set(b.get("serve", {}))):
+        sa = a.get("serve", {}).get(key, {})
+        sb = b.get("serve", {}).get(key, {})
+        # Batch fill is a fraction where HIGHER is better (fuller
+        # batches), so it is exempt from the latency/backlog heuristics
+        # even though serve_fill_p95 matches the 'p95' substring.
+        add(key, sa.get("steady"), sb.get("steady"),
+            lower_better=(
+                "fill" not in key
+                and (
+                    "_ms" in key or "p95" in key or "overload" in key
+                    or "error" in key or "fallback" in key or "depth" in key
+                )
             ))
     for key in sorted(set(a.get("pod", {})) | set(b.get("pod", {}))):
         if key == "pod_resume_step_elected":

@@ -830,6 +830,43 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # priority (no queueing on the hot path), full transfer_* accounting.
     learner.transfer = transfer_sched
 
+    # --- batched policy-inference service (serve/; docs/SERVING.md) ---
+    # config.serve_actors: one InferenceServer in this process serves
+    # mu(s) to the whole worker fleet through a dynamic batcher
+    # (serve_max_batch / serve_max_latency_ms dispatch). Params refresh
+    # from the SAME shared-memory broadcast buffer the workers poll
+    # (pool.param_source()), batch applies ride the transfer scheduler's
+    # `serve` class (byte-fair with ingest/prefetch, never ahead of
+    # lockstep), and workers degrade to their local act() mirror when the
+    # served path cannot answer (the failure contract the serve chaos
+    # tests pin).
+    serve_server = None
+    serve_front = None
+    if config.serve_actors:
+        from distributed_ddpg_tpu.serve import InferenceServer, ServeFront
+
+        serve_server = InferenceServer(
+            pool.layout,
+            spec.action_scale,
+            spec.action_offset,
+            max_batch=config.serve_max_batch,
+            max_latency_s=config.serve_max_latency_ms / 1000.0,
+            max_queue=config.serve_queue,
+            backend=config.serve_backend,
+            param_source=pool.param_source(),
+            scheduler=transfer_sched,
+            seed=config.seed,
+            fault_batcher=(
+                fault_plan.site("serve", "batcher") if fault_plan else None
+            ),
+            fault_dispatch=(
+                fault_plan.site("serve", "dispatch") if fault_plan else None
+            ),
+        ).start()
+        serve_front = ServeFront(
+            serve_server, *pool.serve_channels()
+        ).start()
+
     pool.start(learner.actor_params_to_host())
     _beat()  # first params d2h survived (an observed wedge point)
     log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
@@ -964,6 +1001,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         docs/RESILIENCE.md 'Numerical health') for every train/final
         record when guardrails are armed. Records stay clean otherwise."""
         return gstats.snapshot() if guard_on else {}
+
+    def serve_fields() -> Dict[str, float]:
+        """serve_* inference-service counters (metrics.ServeStats;
+        docs/SERVING.md) for every train/final record when serving is
+        armed — request/batch totals, batch-fill, latency tails, queue
+        depth, and the workers' local-act fallback count."""
+        if serve_server is None:
+            return {}
+        return {**serve_server.snapshot(), **pool.serve_counters()}
 
     def _guard_quarantine_sources() -> None:
         """Bad-row -> ingest-source attribution: fetch the offending
@@ -1454,6 +1500,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 **pod_fields(),
                 # Numerical health (docs/RESILIENCE.md; guardrails.py).
                 **guardrail_fields(),
+                # Inference serving (docs/SERVING.md; serve/).
+                **serve_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -1849,6 +1897,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             pass  # a failing beat must not mask the primary error
         pool.stop()
         _beat()
+        if serve_front is not None:
+            # After the workers: no new requests can arrive. The front
+            # stops first (nothing new enters the batcher), then the
+            # server flushes — every accepted request completes before
+            # the machinery under it (scheduler) is torn down.
+            serve_front.stop()
+        if serve_server is not None:
+            serve_server.close()
         if use_device_replay and device_replay is not None:
             # Stop the async ingest shipper; add_packed falls back to
             # inline shipping for any teardown stragglers.
@@ -1883,6 +1939,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
         final_return = _eval_numpy(eval_policy, config, spec)
     rate = learn_timer.rate()
+    # ONE serve snapshot shared by the final record and the returned
+    # summary: ServeStats.snapshot resets the interval reservoirs, so a
+    # second call would report zeroed latency/fill/depth tails.
+    serve_final = serve_fields()
     log.log(
         "final", env_steps(),
         learner_steps=learn_steps,
@@ -1893,6 +1953,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **transfer_fields(),
         **pod_fields(),
         **guardrail_fields(),
+        **serve_final,
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the
@@ -1919,6 +1980,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **recovery_fields(),
         **pod_fields(),
         **guardrail_fields(),
+        **serve_final,
     }
 
 

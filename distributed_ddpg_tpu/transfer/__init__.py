@@ -2,7 +2,8 @@
 
 One subsystem owns every host<->device stream the trainer produces —
 inbound replay ingest super-blocks, outbound chunk-prefetch h2d, learner
-params/metrics d2h, and the multi-host lockstep ingest collective —
+params/metrics d2h, policy-inference batch dispatches (the `serve` class;
+serve/, docs/SERVING.md), and the multi-host lockstep ingest collective —
 replacing the two private per-component threads (the `_IngestShipper` in
 replay/device.py and the `ChunkPrefetcher`'s inline `device_put`) that
 previously competed blindly for h2d bandwidth.

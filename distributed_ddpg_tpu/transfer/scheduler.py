@@ -22,6 +22,12 @@ stream with no policy at all.
              protocol).
   ingest     inbound staged-replay super-blocks (h2d + jitted insert).
   prefetch   outbound sampled-chunk h2d (host-replay mode).
+  serve      policy-inference batch dispatches (serve/; docs/SERVING.md):
+             the obs-batch h2d + policy apply + action d2h of one
+             dynamic-batched inference call. Byte-fair alongside
+             ingest/prefetch — serving traffic shares the bus under the
+             same accounting as training traffic, and can never jump
+             ahead of a lockstep collective.
   d2h        learner params/metrics pulls. These are learner-critical
              and synchronous by nature, so they run INLINE on the caller
              thread with absolute priority — the scheduler accounts
@@ -29,11 +35,12 @@ stream with no policy at all.
              the transfer_* observability) without adding queueing
              latency to the hot path.
 
-Between `ingest` and `prefetch` the scheduler start-time fair-queues by
-bytes (virtual-time per class, weight-scaled): under an ingest flood a
-newly arrived prefetch item is picked as soon as the in-flight item
-finishes, and vice versa — neither stream can starve the other by more
-than one item's dispatch time (tests/test_transfer.py pins the bound).
+Between `ingest`, `prefetch`, and `serve` the scheduler start-time
+fair-queues by bytes (virtual-time per class, weight-scaled): under an
+ingest flood a newly arrived prefetch or serve item is picked as soon as
+the in-flight item finishes, and vice versa — no stream can starve
+another by more than one item's dispatch time (tests/test_transfer.py
+pins the bound).
 A class idle for a long stretch re-enters at the current virtual time,
 so it cannot bank unbounded credit and then starve everyone else.
 
@@ -63,13 +70,15 @@ from distributed_ddpg_tpu import trace
 from distributed_ddpg_tpu.metrics import TransferStats
 
 # Work classes. Order here is documentation only; scheduling policy is
-# lockstep-first, then byte-fair between ingest/prefetch, d2h inline.
+# lockstep-first, then byte-fair between ingest/prefetch/serve, d2h inline.
 LOCKSTEP = "lockstep"
 INGEST = "ingest"
 PREFETCH = "prefetch"
+SERVE = "serve"
 D2H = "d2h"
 
-_QUEUED_CLASSES = (LOCKSTEP, INGEST, PREFETCH)
+_QUEUED_CLASSES = (LOCKSTEP, INGEST, PREFETCH, SERVE)
+_FAIR_CLASSES = (INGEST, PREFETCH, SERVE)
 
 
 class TransferError(RuntimeError):
@@ -160,8 +169,9 @@ class TransferScheduler:
         # Start-time fair queuing state: per-class virtual time advanced by
         # bytes/weight on dispatch; an empty class re-enters at the global
         # virtual time so idle periods never bank starvation-scale credit.
-        self._weights = {INGEST: 1.0, PREFETCH: 1.0, **(weights or {})}
-        self._vt = {INGEST: 0.0, PREFETCH: 0.0}
+        self._weights = {c: 1.0 for c in _FAIR_CLASSES}
+        self._weights.update(weights or {})
+        self._vt = {c: 0.0 for c in _FAIR_CLASSES}
         self._global_vt = 0.0
         self._stop = False
         self._dead_exc: Optional[BaseException] = None
@@ -278,7 +288,7 @@ class TransferScheduler:
     def _pick_locked(self) -> Optional[_Item]:
         if self._queues[LOCKSTEP]:
             return self._queues[LOCKSTEP].popleft()
-        backlogged = [c for c in (INGEST, PREFETCH) if self._queues[c]]
+        backlogged = [c for c in _FAIR_CLASSES if self._queues[c]]
         if not backlogged:
             return None
         cls = min(backlogged, key=lambda c: self._vt[c])
