@@ -30,7 +30,10 @@ overrides the learner chunk length for the accelerator phase;
 BENCH_INGEST_ASYNC=0 / BENCH_INGEST_COALESCE=1 fall back to the seed's
 serial inline replay ingest for A/B runs (docs/INGEST.md); BENCH_SERVE=1
 adds the serve-path measurement (served throughput + p50/p95 with a
-per-worker act() A/B at each client count — docs/SERVING.md).
+per-worker act() A/B at each client count — docs/SERVING.md);
+BENCH_DEVACTOR=1 adds the device-actor rollout A/B (on-device vectorized
+rollouts vs the host-pool path at equal env count E, rows/s curve over E
+— docs/DEVICE_ACTORS.md; BENCH_DEVACTOR_ENVS overrides the E list).
 """
 
 from __future__ import annotations
@@ -587,6 +590,154 @@ def phase_serve() -> dict:
     }
 
 
+def phase_devactor() -> dict:
+    """Device-actor vs host-pool rollout A/B (BENCH_DEVACTOR=1;
+    docs/DEVICE_ACTORS.md): transition rows/s at equal env count E for
+
+      devactor  — actors/device_pool.py: ONE jitted lax.scan chunk steps E
+                  vmapped JaxPendulum envs (policy mu(s) + per-env OU noise
+                  on device) and scatters rows into DeviceReplay's HBM
+                  ring with a donated insert — zero host bytes per row;
+      host      — the host-pool path modeled tightly: numpy policy act
+                  over the E-batch (one GEMM — FLATTERING the real pool,
+                  which acts per worker at B=1), numpy OU noise, E builtin
+                  Pendulum envs stepped in Python, rows packed and shipped
+                  host->HBM through add_packed (staging ring + coalesced
+                  insert — the real ingest pipeline).
+
+    CPU-only and tunnel-independent. The headline devactor_rows_per_s
+    lands at the top level of the bench JSON, arming scripts/ci_gate.sh's
+    higher-is-better devactor_rows_per_s key once a BENCH_DEVACTOR=1 bench
+    becomes the baseline."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_ddpg_tpu.actors.device_pool import DeviceActorPool
+    from distributed_ddpg_tpu.actors.policy import (
+        NumpyPolicy,
+        flatten_params,
+        param_layout,
+    )
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.envs.pendulum import Pendulum
+    from distributed_ddpg_tpu.learner import init_train_state
+    from distributed_ddpg_tpu.ops.noise import OUNoise
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "2"))
+    env_counts = [
+        int(x)
+        for x in os.environ.get("BENCH_DEVACTOR_ENVS", "64,256,1024").split(",")
+        if x
+    ]
+    chunk = int(os.environ.get("BENCH_DEVACTOR_CHUNK", "16"))
+    mesh = mesh_lib.make_mesh(
+        data_axis=1, model_axis=1, devices=jax.devices()[:1]
+    )
+    curve = {}
+    for E in env_counts:
+        cfg = DDPGConfig(
+            env_id="Pendulum-v1",
+            actor_backend="device",
+            num_actors=0,
+            device_actor_envs=E,
+            device_actor_chunk=chunk,
+            actor_hidden=HIDDEN,
+            critic_hidden=HIDDEN,
+            replay_capacity=max(65_536, 4 * E * chunk),
+        )
+        pool = DeviceActorPool(cfg, mesh=mesh)
+        state = init_train_state(cfg, pool.obs_dim, pool.act_dim, seed=0)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        params = jax.device_put(
+            state.actor_params,
+            jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                         state.actor_params),
+        )
+        pool.set_params(params)
+        replay = DeviceReplay(
+            cfg.replay_capacity, pool.obs_dim, pool.act_dim, mesh=mesh,
+            block_size=1024, async_ship=False,
+        )
+        pool.run_chunk(replay)  # warmup: rollout + insert compile
+        jax.block_until_ready(replay.storage)
+        t0 = time.perf_counter()
+        rows = 0
+        while time.perf_counter() - t0 < seconds:
+            rows += pool.run_chunk(replay)
+        jax.block_until_ready(replay.storage)  # dispatched != landed
+        dev_rate = rows / (time.perf_counter() - t0)
+
+        # Host-pool reference at the same E (docstring: deliberately
+        # flattered — batched act, no process/transport overhead).
+        layout = param_layout(pool.obs_dim, pool.act_dim, HIDDEN)
+        policy = NumpyPolicy(
+            layout, pool.action_scale, pool.action_offset
+        )
+        policy.load_flat(flatten_params(jax.device_get(state.actor_params)))
+        envs = [Pendulum(seed=i) for i in range(E)]
+        obs = np.stack([e.reset(seed=i)[0] for i, e in enumerate(envs)])
+        ou = OUNoise((E, pool.act_dim), cfg.ou_theta, cfg.ou_sigma, seed=1)
+        host_replay = DeviceReplay(
+            cfg.replay_capacity, pool.obs_dim, pool.act_dim, mesh=mesh,
+            block_size=1024, async_ship=False,
+        )
+        low, high = pool.env.action_low, pool.env.action_high
+        t0 = time.perf_counter()
+        host_rows = 0
+        pend = {k: [] for k in ("obs", "action", "reward", "discount",
+                                "next_obs")}
+        while time.perf_counter() - t0 < seconds:
+            actions = np.clip(
+                policy(obs) + ou() * pool.action_scale, low, high
+            ).astype(np.float32)
+            nxt = np.empty_like(obs)
+            rewards = np.empty(E, np.float32)
+            for i, e in enumerate(envs):
+                o, r, term, trunc, _ = e.step(actions[i])
+                rewards[i] = r
+                if term or trunc:
+                    o, _ = e.reset()
+                    ou.state[i] = 0.0
+                nxt[i] = o
+            pend["obs"].append(obs.copy())
+            pend["action"].append(actions)
+            pend["reward"].append(rewards)
+            pend["discount"].append(np.full(E, cfg.gamma, np.float32))
+            pend["next_obs"].append(nxt.copy())
+            host_rows += E
+            obs = nxt
+            if host_rows % (1024 * 4) < E:
+                host_replay.add_packed(pack_batch_np(
+                    {k: np.concatenate(v) for k, v in pend.items()}
+                ))
+                pend = {k: [] for k in pend}
+        if pend["obs"]:
+            host_replay.add_packed(pack_batch_np(
+                {k: np.concatenate(v) for k, v in pend.items()}
+            ))
+        host_replay.drain_pending()
+        host_rate = host_rows / (time.perf_counter() - t0)
+        replay.close()
+        host_replay.close()
+        curve[str(E)] = {
+            "devactor_rows_per_s": round(dev_rate, 1),
+            "host_rows_per_s": round(host_rate, 1),
+            "devactor_vs_host": round(dev_rate / max(host_rate, 1e-9), 2),
+            "chunk": chunk,
+        }
+    head = curve[str(max(int(k) for k in curve))]
+    return {
+        "devactor_scaling": curve,
+        "devactor_rows_per_s": head["devactor_rows_per_s"],
+        "devactor_host_rows_per_s": head["host_rows_per_s"],
+        "devactor_vs_host": head["devactor_vs_host"],
+    }
+
+
 _PHASES = {
     "native": phase_native,
     "probe": phase_probe,
@@ -595,6 +746,7 @@ _PHASES = {
     "scaling": phase_scaling,
     "study": phase_study,
     "serve": phase_serve,
+    "devactor": phase_devactor,
 }
 
 
@@ -896,6 +1048,20 @@ def main() -> int:
         )
         if serve_res:
             result.update(serve_res)
+        else:
+            errors.append(err)
+
+    # Device-actor rollout A/B (BENCH_DEVACTOR=1; docs/DEVICE_ACTORS.md):
+    # CPU-only and tunnel-independent, so it runs after the accelerator
+    # capture. The top-level devactor_rows_per_s arms ci_gate.sh's
+    # higher-is-better devactor key once this bench becomes the baseline.
+    if os.environ.get("BENCH_DEVACTOR", "0") == "1" and not study_only:
+        note("device-actor bench phase")
+        dev_res, err = _run_phase(
+            "devactor", {"JAX_PLATFORMS": "cpu"}, timeout=600
+        )
+        if dev_res:
+            result.update(dev_res)
         else:
             errors.append(err)
 

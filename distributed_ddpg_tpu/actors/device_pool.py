@@ -1,0 +1,351 @@
+"""On-device vectorized actors: Podracer/Anakin-style rollouts that never
+leave HBM (config.actor_backend='device'; docs/DEVICE_ACTORS.md; PAPERS.md
+arXiv 2104.06272, with the device-resident sample path motivated by the
+in-network experience-sampling line, arXiv 2110.13506).
+
+The host pool (actors/pool.py) steps CPU envs in worker processes, runs OU
+noise in numpy, and ships rows host->HBM through the ingest pipeline —
+mandatory for Gym/Mujoco, but for envs with JAX dynamics
+(envs/jax_envs.py) it caps rollout throughput at the host ingest path
+(~300 rows/ms measured ceiling) while the accelerator learner is hundreds
+of times faster than the CPU baseline. This pool removes the host from the
+experience path entirely:
+
+  - ONE jitted program per chunk: a `lax.scan` of K iterations, each
+    advancing E vmapped envs — per-env OU noise update, a = clip(mu(s) +
+    ou * scale, bounds) (one MXU matmul over the E-batch), vmapped
+    env.step with auto-reset, and the packed [E, D] transition rows —
+    returning a [K*E, D] block that is already device-resident;
+  - the block scatters into DeviceReplay's HBM ring via
+    `DeviceReplay.insert_device_rows` (a donated jitted insert): no host
+    staging ring, no transfer-scheduler ingest class, zero host<->device
+    bytes per transition. The scheduler keeps its other lanes (lockstep /
+    prefetch / d2h / serve) untouched;
+  - param refresh is a POINTER SWAP: `set_params` stores a reference to
+    the learner's live (device-resident, correctly sharded) actor params,
+    and the next rollout dispatch reads them — no pool-broadcast
+    shared-memory copy, no d2h. train.py re-swaps every chunk (the
+    previous chunk's dispatch DONATED the old TrainState, so the stale
+    reference must never be dispatched again).
+
+Unlike `backend='jax_ondevice'` (the fused env+replay+learner monolith),
+the learner keeps its full feature set — PER, guardrails, serving,
+multi-host — because replay stays an ordinary DeviceReplay and the learner
+programs are unchanged; this module only replaces WHO produces the rows.
+The host pool can run alongside (num_actors > 0): both sources feed the
+same ring, host rows through the ingest pipeline, device rows through the
+donated insert, with the replay's host pointer-mirror advanced for both so
+source attribution (guardrails) stays aligned.
+
+Multi-host: the rollout and the insert are global SPMD programs over the
+learner's (possibly process-spanning) mesh — every process executes the
+identical program at the identical loop point (train_jax drives the pool
+at lockstep sites only), so the rows landed in the replicated storage are
+bit-identical on every replica and the `sync_ship` lockstep accounting for
+HOST rows is untouched. Env state shards over the mesh's 'data' axis when
+E divides it (physics FLOPs are negligible — sharding is a bonus); the
+rows output is replicated, which is exactly what the replicated-storage
+insert needs.
+
+Failure contract (docs/RESILIENCE.md discipline): the `devactor:rollout`
+chaos site ticks once per dispatch; a dispatch-time failure that left the
+carry intact restarts bounded (<= 3, counter devactor_restarts, trace
+instant devactor_restart) — past the budget, or when the donated carry was
+already consumed, a typed DeviceActorError surfaces to the trainer.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ddpg_tpu import trace
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.envs.jax_envs import make_jax_env
+from distributed_ddpg_tpu.metrics import DevActorStats
+from distributed_ddpg_tpu.ops.exploration import vector_env_step
+
+
+class DeviceActorError(RuntimeError):
+    """The device-actor rollout loop died past its bounded-restart budget
+    (or with its donated carry already consumed); the original exception
+    rides along as __cause__ — the same surfacing discipline as
+    IngestError / PrefetchTimeout."""
+
+
+def resolve_device_actor_chunk(config: DDPGConfig) -> int:
+    """K (env steps per rollout dispatch): config.device_actor_chunk when
+    set, else 64 on kernel-native TPU backends and 8 elsewhere — the same
+    resolution discipline as resolve_learner_chunk, so CPU dev/test
+    dispatches stay snappy while TPU chunks amortize dispatch overhead."""
+    if config.device_actor_chunk > 0:
+        return config.device_actor_chunk
+    from distributed_ddpg_tpu.ops.fused_chunk import runs_native
+
+    return 64 if runs_native() else 8
+
+
+class ActorCarry(NamedTuple):
+    """Everything the rollout loop owns between dispatches, as one donated
+    pytree. Cumulative episode stats live ON DEVICE so the host only pays
+    a two-scalar d2h at log cadence (snapshot), never per chunk."""
+
+    env_state: object        # vmapped env state pytree, leading dim E
+    obs: jnp.ndarray         # f32[E, obs_dim] current policy observations
+    ou: jnp.ndarray          # f32[E, act_dim] OU noise state
+    ep_ret: jnp.ndarray      # f32[E] running episode returns
+    steps: jnp.ndarray       # i32[] cumulative env steps (warmup gate)
+    episodes: jnp.ndarray    # i32[] cumulative finished episodes
+    ret_sum: jnp.ndarray     # f32[] cumulative sum of finished returns
+    key: jnp.ndarray         # PRNG key
+
+
+class DeviceActorPool:
+    """E vectorized JAX envs + policy + OU noise as one compiled rollout
+    chunk, feeding DeviceReplay without leaving HBM (module docstring)."""
+
+    def __init__(
+        self,
+        config: DDPGConfig,
+        mesh: Optional[Mesh] = None,
+        fault=None,
+        warmup_offset: int = 0,
+    ):
+        from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+        self.config = config
+        self.env = make_jax_env(config.env_id)
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+            config.data_axis, config.model_axis
+        )
+        self.num_envs = E = int(config.device_actor_envs)
+        self.chunk_size = K = resolve_device_actor_chunk(config)
+        self.rows_per_chunk = K * E
+        self._fault = fault
+        self._stats = DevActorStats(seed=config.seed)
+        self._params = None
+        self._restarts = 0
+        self._max_restarts = 3
+        self._dispatches = 0
+        self._steps = 0
+        # Interval episode accounting: snapshot() differences the carry's
+        # cumulative device counters against these host mirrors.
+        self._eps_seen = 0
+        self._ret_seen = 0.0
+
+        env = self.env
+        obs_dim, act_dim = env.obs_dim, env.act_dim
+        self.obs_dim, self.act_dim = obs_dim, act_dim
+        scale = ((env.action_high - env.action_low) / 2.0).astype(np.float32)
+        offset = ((env.action_high + env.action_low) / 2.0).astype(np.float32)
+        self.action_scale, self.action_offset = scale, offset
+        low = jnp.asarray(env.action_low)
+        high = jnp.asarray(env.action_high)
+        cfg = config
+        # REMAINING uniform-warmup budget (actors/pool.py
+        # warmup_budget_per_worker parity): resumed progress counts against
+        # the global budget, so a restored run never re-injects random
+        # actions into a trained replay.
+        warmup_uniform = max(
+            0, cfg.resolved_warmup_uniform() - int(warmup_offset)
+        )
+
+        # Envs shard over 'data' when divisible; replicate otherwise (the
+        # ondevice.py rule — physics FLOPs are negligible either way).
+        data_size = self.mesh.shape["data"]
+        env_axis = "data" if E % data_size == 0 else None
+
+        def env_step(params, carry: ActorCarry):
+            """One vectorized env step — the shared ops/exploration body
+            (noise -> action -> vmapped step -> packed rows; key always
+            splits 4 ways so the host-stepped parity reference in the
+            tests can replay the exact stream) plus this pool's episode
+            accounting. The warmup gate reads the pool's OWN cumulative
+            step counter (the ondevice monolith gates on its ring fill;
+            this pool shares the ring with other sources, so it counts
+            its own production instead)."""
+            key, ou, action, out, rows = vector_env_step(
+                cfg, env, E, params, carry.env_state, carry.obs, carry.ou,
+                carry.key, scale, offset, low, high,
+                warmup_active=(
+                    carry.steps < warmup_uniform
+                    if warmup_uniform > 0
+                    else None
+                ),
+            )
+            ep_ret = carry.ep_ret + out.reward
+            done_ret = jnp.where(out.done, ep_ret, 0.0)
+            new_carry = ActorCarry(
+                env_state=out.state,
+                obs=out.obs,
+                ou=ou,
+                ep_ret=jnp.where(out.done, 0.0, ep_ret),
+                steps=carry.steps + E,
+                episodes=carry.episodes + out.done.sum().astype(jnp.int32),
+                ret_sum=carry.ret_sum + done_ret.sum(),
+                key=key,
+            )
+            return new_carry, rows
+
+        def rollout(params, carry: ActorCarry):
+            carry, rows = jax.lax.scan(
+                lambda c, _: env_step(params, c), carry, None, length=K
+            )
+            # [K, E, D] -> [K*E, D], step-major: row order matches K serial
+            # E-wide inserts, so the ring layout is what a per-step insert
+            # sequence would have produced.
+            return carry, rows.reshape(K * E, rows.shape[-1])
+
+        # --- shardings + initial carry ---
+        key = jax.random.PRNGKey(config.seed + 0xDA)
+        k_init, k_run = jax.random.split(key)
+        env_state = jax.vmap(env.init)(jax.random.split(k_init, E))
+        carry = ActorCarry(
+            env_state=env_state,
+            obs=jax.vmap(env.observe)(env_state),
+            ou=jnp.zeros((E, act_dim), jnp.float32),
+            ep_ret=jnp.zeros((E,), jnp.float32),
+            steps=jnp.zeros((), jnp.int32),
+            episodes=jnp.zeros((), jnp.int32),
+            ret_sum=jnp.zeros((), jnp.float32),
+            key=k_run,
+        )
+        carry_spec = ActorCarry(
+            env_state=jax.tree.map(lambda _: P(env_axis), env_state),
+            obs=P(env_axis, None),
+            ou=P(env_axis, None),
+            ep_ret=P(env_axis),
+            steps=P(),
+            episodes=P(),
+            ret_sum=P(),
+            key=P(),
+        )
+        self._carry_sharding = mesh_lib.to_named(self.mesh, carry_spec)
+        # Rows come out REPLICATED: that is the block sharding
+        # DeviceReplay's donated insert expects against its replicated
+        # storage (and what makes multi-host replicas bit-identical).
+        rows_sharding = NamedSharding(self.mesh, P(None, None))
+        # Params keep whatever sharding the learner's live tree carries
+        # (replicated, or TP-sharded under model_axis > 1): no in_shardings
+        # pin, so the pointer-swap refresh never pays a resharding copy.
+        self._rollout = jax.jit(
+            rollout,
+            out_shardings=(self._carry_sharding, rows_sharding),
+            donate_argnums=(1,),
+        )
+        self._carry: ActorCarry = jax.device_put(carry, self._carry_sharding)
+
+    # --- param refresh (device-side pointer swap) ---
+
+    def set_params(self, actor_params) -> None:
+        """Swap in the learner's LIVE actor params (a device-resident
+        pytree reference — nothing is copied or transferred). Callers must
+        re-swap after every learner dispatch that donates the TrainState:
+        the previously-stored tree is deleted by that donation, and
+        dispatching a rollout against it would raise. train.py does this
+        at the top of every after_chunk."""
+        self._params = actor_params
+
+    # --- driving ---
+
+    def run_chunk(self, replay) -> int:
+        """One rollout dispatch: K scan steps x E envs -> [K*E, D] rows ->
+        donated scatter into `replay` (DeviceReplay.insert_device_rows).
+        Returns rows produced. Dispatch-time failures with the carry
+        intact restart bounded (module docstring failure contract)."""
+        if self._params is None:
+            raise DeviceActorError(
+                "set_params() must install the learner's live actor params "
+                "before the first rollout dispatch"
+            )
+        while True:
+            try:
+                # Chaos site ticks BEFORE the dispatch consumes the donated
+                # carry, so an injected crash always leaves it retryable.
+                if self._fault is not None:
+                    self._fault.tick()
+                t0 = time.perf_counter()
+                with trace.span(
+                    "devactor_rollout",
+                    rows=self.rows_per_chunk, envs=self.num_envs,
+                ):
+                    carry, rows = self._rollout(self._params, self._carry)
+                    self._carry = carry
+                    replay.insert_device_rows(rows)
+                dt = time.perf_counter() - t0
+            except Exception as e:  # NOT BaseException: Ctrl-C must abort
+                if not self._recoverable(e):
+                    raise DeviceActorError(
+                        "device-actor rollout failed past the restart "
+                        "budget"
+                    ) from e
+                continue
+            self._stats.record_chunk(self.rows_per_chunk, dt)
+            self._dispatches += 1
+            self._steps += self.rows_per_chunk
+            return self.rows_per_chunk
+
+    def _recoverable(self, exc: Exception) -> bool:
+        """Bounded-restart policy: recover only while the budget holds AND
+        the donated carry is still intact (a failure after donation
+        consumed the buffers cannot be retried against deleted arrays —
+        the run_sample_chunk fallback's discipline). Single-process ONLY:
+        on a multi-host mesh the rollout+insert are global SPMD programs,
+        and a per-process retry would enqueue extra programs on THIS
+        process alone — forking the pod's per-process device-op order
+        (the docs/TRANSFER.md invariant). There the failure must surface
+        immediately so the pod deadline/abort contract (PodPeerLost,
+        exit 76) handles it pod-wide."""
+        if jax.process_count() > 1:
+            return False
+        if self._restarts >= self._max_restarts:
+            return False
+        if any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree.leaves(self._carry)
+        ):
+            return False
+        self._restarts += 1
+        trace.instant("devactor_restart", n=self._restarts)
+        print(
+            f"[devactor] rollout dispatch failed ({exc!r}); restarting "
+            f"({self._restarts}/{self._max_restarts})",
+            file=sys.stderr, flush=True,
+        )
+        return True
+
+    # --- host-side views ---
+
+    @property
+    def steps_done(self) -> int:
+        """Env steps produced so far (host counter — dispatches * K * E;
+        identical on every process, so multi-host budget math may use it)."""
+        return self._steps
+
+    @property
+    def restarts(self) -> int:
+        return self._restarts
+
+    def snapshot(self) -> dict:
+        """devactor_* observability fields for the train/final records:
+        interval rows/s + per-chunk dispatch tails (metrics.DevActorStats)
+        plus the episode stats differenced from the carry's cumulative
+        device counters — a two-scalar d2h, paid only at log cadence."""
+        out = self._stats.snapshot()
+        eps = int(jax.device_get(self._carry.episodes))
+        ret = float(jax.device_get(self._carry.ret_sum))
+        d_eps = eps - self._eps_seen
+        d_ret = ret - self._ret_seen
+        self._eps_seen, self._ret_seen = eps, ret
+        out["devactor_env_steps"] = self._steps
+        out["devactor_episodes"] = eps
+        if d_eps > 0:
+            out["devactor_episode_return"] = round(d_ret / d_eps, 6)
+        out["devactor_restarts"] = self._restarts
+        return out

@@ -187,6 +187,33 @@ class DDPGConfig:
     # parity, like the learner itself).
     serve_backend: str = "numpy"
 
+    # --- device-actor backend (actors/device_pool.py; docs/DEVICE_ACTORS.md) ---
+    # Where rollouts run on the jax_tpu path. "host" (default): N worker
+    # PROCESSES step CPU envs, OU noise runs in numpy, and rows cross
+    # host->HBM through the ingest pipeline — the only option for
+    # Gym/Mujoco envs. "device": a Podracer/Anakin-style vectorized actor
+    # (PAPERS.md arXiv 2104.06272) — one jitted lax.scan advances
+    # device_actor_envs copies of the JAX env (envs/jax_envs.py), the
+    # policy mu(s) and per-env OU noise run in the same program, and the
+    # transition rows scatter STRAIGHT into DeviceReplay's HBM ring with a
+    # donated insert: no host staging, no transfer-scheduler ingest class,
+    # zero host<->device bytes on the experience path. Param refresh is a
+    # device-side pointer swap from the learner's live params. Requires a
+    # JAX env implementation (has_jax_env), validated at parse. Unlike
+    # backend='jax_ondevice' (the fused monolith), the learner keeps its
+    # full feature set — PER, guardrails, serving, multi-host — and the
+    # host pool can run alongside (num_actors > 0) feeding the same replay.
+    actor_backend: str = "host"
+    # E: vectorized envs advanced per device-actor chunk (the rollout's
+    # vmap width). Thousands are cheap on a TPU — env physics is a few
+    # FLOPs per step; CPU tests use small values.
+    device_actor_envs: int = 1024
+    # K: env steps per rollout dispatch (the lax.scan length); each chunk
+    # produces K * device_actor_envs transitions in one program.
+    # 0 = auto: 64 on kernel-native TPU backends, 8 elsewhere (mirrors
+    # learner_chunk's resolution discipline).
+    device_actor_chunk: int = 0
+
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15
     ou_sigma: float = 0.2
@@ -678,6 +705,87 @@ class DDPGConfig:
                     "with a local RNG, which a shared server cannot "
                     "replicate per client — run SAC on the per-worker "
                     "act() path"
+                )
+        if self.actor_backend not in ("host", "device"):
+            raise ValueError(
+                f"actor_backend must be 'host' or 'device', got "
+                f"{self.actor_backend!r}"
+            )
+        if self.device_actor_envs < 1:
+            raise ValueError("device_actor_envs must be >= 1")
+        if self.device_actor_chunk < 0:
+            raise ValueError("device_actor_chunk must be >= 0 (0 = auto)")
+        if self.num_actors < 0 or (
+            self.num_actors == 0 and self.actor_backend != "device"
+        ):
+            raise ValueError(
+                "num_actors must be >= 1 (0 is allowed only with "
+                "actor_backend='device', where the on-device rollout loop "
+                "is the experience source and the host pool runs empty)"
+            )
+        if self.actor_backend == "device":
+            if self.backend != "jax_tpu":
+                raise ValueError(
+                    "actor_backend='device' runs the vectorized rollout "
+                    "loop inside the jax_tpu trainer; the native backend "
+                    "has no device, and jax_ondevice already fuses its "
+                    "envs into the learner monolith — use backend='jax_tpu'"
+                )
+            # Lazy import: jax_envs pulls in jax, which config parsing must
+            # not pay for on the (default) host path.
+            from distributed_ddpg_tpu.envs.jax_envs import (
+                _JAX_ENVS,
+                has_jax_env,
+            )
+
+            if not has_jax_env(self.env_id):
+                raise ValueError(
+                    f"actor_backend='device' needs an on-device (JAX) "
+                    f"implementation of {self.env_id!r}; available: "
+                    f"{sorted(set(_JAX_ENVS))} — keep actor_backend='host' "
+                    "for Gym/Mujoco envs (docs/DEVICE_ACTORS.md)"
+                )
+            if self.serve_actors:
+                raise ValueError(
+                    "serve_actors batches host workers' act() requests; "
+                    "device actors never call act() on the host — mu(s) "
+                    "runs inside the rollout program. Disable serve_actors "
+                    "(or serve a host pool alongside via actor_backend="
+                    "'host')"
+                )
+            if self.n_step != 1:
+                raise ValueError(
+                    "actor_backend='device' stores 1-step transitions "
+                    "(the n-step window is a host-side accumulator, "
+                    "replay/nstep.py); use the host pool for n_step > 1"
+                )
+            if self.host_replay:
+                raise ValueError(
+                    "actor_backend='device' scatters rollout rows "
+                    "directly into DeviceReplay's HBM ring; host_replay "
+                    "has no device ring to insert into — disable one"
+                )
+            if self.strict_sync:
+                raise ValueError(
+                    "strict_sync's lockstep schedule is defined over the "
+                    "host pool's deterministic drain budget; device-actor "
+                    "chunks dispatch outside it — use actor_backend='host' "
+                    "for lockstep debugging"
+                )
+            from distributed_ddpg_tpu.actors.device_pool import (
+                resolve_device_actor_chunk,
+            )
+
+            rows = self.device_actor_envs * resolve_device_actor_chunk(self)
+            if rows > self.replay_capacity:
+                raise ValueError(
+                    f"one device-actor chunk produces {rows} rows "
+                    f"(device_actor_envs={self.device_actor_envs} x "
+                    f"chunk {resolve_device_actor_chunk(self)}) — more "
+                    f"than replay_capacity={self.replay_capacity}: the "
+                    "scatter insert would write duplicate ring positions "
+                    "in unspecified order. Shrink the chunk/env count or "
+                    "grow the replay"
                 )
         # Fail fast on fault-grammar typos: a bad spec must die at config
         # parse, not hours later when the fault was scheduled to fire.

@@ -18,7 +18,7 @@ Grammar (';'-separated specs):
 
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
     component := worker | pool | shipper | prefetch | ckpt | transfer | pod
-                 | numeric | serve
+                 | numeric | serve | devactor
     kind      := crash | crashloop | hang | stall | slow | ioerror | kill
                  | nan | inf | spike
 
@@ -82,6 +82,13 @@ Fault semantics by component:
     serve:dispatch:crash@K   the K-th inference-batch apply raises: every
                              request in that batch fails typed, clients
                              fall back locally, the batcher survives
+    devactor:rollout:crash@K the K-th device-actor rollout dispatch raises
+                             (actors/device_pool.py) — the pool's bounded
+                             self-restart path absorbs it (counter
+                             devactor_restarts); past the budget a typed
+                             DeviceActorError surfaces to the trainer
+    devactor:rollout:slow@K~S the K-th rollout dispatch sleeps S first
+                             (throughput-dent flavor; rows still land)
 
 Numeric `at` ordinals count GUARDED learner steps on a monotonic clock
 (guardrails.GuardState.total) that is deliberately NOT rolled back by the
@@ -110,7 +117,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
-              "pod", "numeric", "serve")
+              "pod", "numeric", "serve", "devactor")
 KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill",
          "nan", "inf", "spike")
 

@@ -274,6 +274,82 @@ class IngestStats:
         return out
 
 
+class DevActorStats:
+    """Counters for the device-actor subsystem (actors/device_pool.py;
+    docs/DEVICE_ACTORS.md) — the `devactor_*` family every train/final
+    JSONL record carries when actor_backend='device'. Throughput and the
+    per-chunk dispatch tails are interval-scoped (each record describes
+    its own window, the IngestStats discipline); restarts and the episode
+    counter are cumulative. Single-threaded by construction (only the
+    learner thread dispatches rollouts), but locked anyway so a future
+    driver thread can't silently race it:
+
+      devactor_rows_per_s   transition rows landed in HBM over the interval
+      devactor_chunks       rollout dispatches in the interval
+      devactor_chunk_ms     mean wall time per rollout dispatch (enqueue +
+                            donated insert — NOT the on-device compute,
+                            which overlaps the learner under async dispatch)
+      devactor_chunk_p50/p95/max
+                            reservoir tails of the same (the per-chunk
+                            step-tail signal: a p95 spike means rollout
+                            dispatch started synchronizing with the
+                            learner stream)
+      devactor_env_steps    cumulative env steps produced by this pool
+      devactor_episodes     cumulative finished episodes
+      devactor_episode_return
+                            mean return of episodes finished this interval
+      devactor_restarts     cumulative bounded-restart recoveries
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._t0 = time.monotonic()
+        self._rows = 0
+        self._chunks = 0
+        self._dur_s = 0.0
+        self._res = _Reservoir(
+            PhaseTimers.RESERVOIR_K,
+            (zlib.crc32(b"devactor_chunk") ^ seed) & 0x7FFFFFFF,
+        )
+
+    def record_chunk(self, rows: int, dur_s: float) -> None:
+        with self._lock:
+            self._rows += int(rows)
+            self._chunks += 1
+            self._dur_s += dur_s
+            self._res.add(dur_s)
+
+    def snapshot(self, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            dt = max(time.monotonic() - self._t0, 1e-9)
+            n = self._chunks
+            out = {
+                "devactor_rows_per_s": round(self._rows / dt, 1),
+                "devactor_chunks": n,
+                "devactor_chunk_ms": (
+                    round(1000.0 * self._dur_s / n, 3) if n else 0.0
+                ),
+                "devactor_chunk_p50": round(
+                    1000.0 * self._res.percentile(0.50), 3
+                ),
+                "devactor_chunk_p95": round(
+                    1000.0 * self._res.percentile(0.95), 3
+                ),
+                "devactor_chunk_max": round(1000.0 * self._res.max, 3),
+            }
+            if reset:
+                self._t0 = time.monotonic()
+                self._rows = 0
+                self._chunks = 0
+                self._dur_s = 0.0
+                self._res = _Reservoir(
+                    PhaseTimers.RESERVOIR_K,
+                    (zlib.crc32(b"devactor_chunk") ^ self._seed) & 0x7FFFFFFF,
+                )
+        return out
+
+
 class TransferStats:
     """Thread-safe counters for the unified transfer scheduler
     (transfer/scheduler.py; docs/TRANSFER.md) — the scheduler-level

@@ -28,10 +28,13 @@ step. Data-parallelism: the minibatch AND the env batch shard over the
 mesh's 'data' axis (envs replicate if E doesn't divide it); params follow
 parallel/mesh.state_pspec (replicated, or TP-sharded when model_axis > 1).
 
-Pendulum note: the only built-in JAX env never terminates (time-limit
-truncation only), so stored discounts are always gamma. Envs with true
-termination must extend StepOut with a `terminated` flag and fold it into
-the discount column here.
+Termination contract: `jax_envs.StepOut.terminated` distinguishes TRUE
+termination (absorbing state — bootstrap discount 0) from time-limit
+truncation (done without terminated — bootstrapping continues), and the
+scan body folds it into the stored discount column as
+`gamma * (1 - terminated)`. JaxPendulum only truncates (discounts are
+always gamma); JaxMountainCar truly terminates at the goal and exercises
+the split end to end (tests/test_ondevice.py).
 """
 
 from __future__ import annotations
@@ -50,7 +53,7 @@ from distributed_ddpg_tpu.learner import (
     init_train_state,
     make_learner_step,
 )
-from distributed_ddpg_tpu.models.mlp import actor_apply
+from distributed_ddpg_tpu.ops.exploration import vector_env_step
 from distributed_ddpg_tpu.parallel import mesh as mesh_lib
 from distributed_ddpg_tpu.types import TrainState, packed_width, unpack_batch
 
@@ -152,73 +155,22 @@ class OnDeviceDDPG:
         warmup_uniform = cfg.resolved_warmup_uniform()
 
         def env_step(carry: Carry):
-            key, k_ou, k_env, k_uni = jax.random.split(carry.key, 4)
-            if cfg.sac:
-                # SAC explores by sampling its own tanh-Gaussian on device;
-                # the OU state rides along as zeros. Uniform warmup
-                # (config.warmup_uniform_steps) is a jnp.where on the ring
-                # fill — no separate compiled warmup program.
-                from distributed_ddpg_tpu.models.mlp import actor_gaussian_apply
-                from distributed_ddpg_tpu.ops import losses as losses_lib
-
-                mean, log_std = actor_gaussian_apply(
-                    carry.train.actor_params,
-                    carry.obs,
-                    cfg.sac_log_std_min,
-                    cfg.sac_log_std_max,
-                )
-                sampled, _ = losses_lib.sac_sample(
-                    mean, log_std, k_ou, scale, offset
-                )
-                action = jnp.clip(sampled, low, high)
-                ou = carry.ou
-            else:
-                ou = (
-                    carry.ou
-                    + cfg.ou_theta * (0.0 - carry.ou) * cfg.ou_dt
-                    + cfg.ou_sigma
-                    * jnp.sqrt(cfg.ou_dt)
-                    * jax.random.normal(k_ou, carry.ou.shape, jnp.float32)
-                )
-                action = jnp.clip(
-                    actor_apply(carry.train.actor_params, carry.obs, scale, offset)
-                    + ou * scale,
-                    low,
-                    high,
-                )
-            if warmup_uniform > 0:
-                # Uniform warmup for EVERY family (worker.py parity; auto
-                # resolves >0 only for SAC, but an explicit
-                # warmup_uniform_steps must mean the same thing on every
-                # backend). Gate on the ring fill — valid because __init__
-                # rejects warmup >= capacity (size saturates there).
-                action = jnp.where(
-                    carry.size < warmup_uniform,
-                    jax.random.uniform(
-                        k_uni, action.shape, jnp.float32,
-                        minval=low, maxval=high,
-                    ),
-                    action,
-                )
-            out = jax.vmap(env.step)(
-                carry.env_state, action, jax.random.split(k_env, E)
-            )
-            # Packed transition rows [E, D] in types.pack_batch_np order.
-            # Discount is 0 where the env truly terminated; time-limit
-            # truncation (done without terminated) keeps bootstrapping.
-            discount = cfg.gamma * (
-                1.0 - jnp.broadcast_to(out.terminated, (E,)).astype(jnp.float32)
-            )
-            rows = jnp.concatenate(
-                [
-                    carry.obs,
-                    action,
-                    out.reward[:, None],
-                    discount[:, None],
-                    out.boot_obs,
-                    jnp.ones((E, 1), jnp.float32),
-                ],
-                axis=-1,
+            # Shared exploration + step + packed-rows body
+            # (ops/exploration.vector_env_step — one implementation for
+            # this monolith AND the device-actor pool). Uniform warmup
+            # (config.warmup_uniform_steps) gates on the RING FILL here —
+            # valid because __init__ rejects warmup >= capacity (size
+            # saturates there); worker.py parity: auto resolves > 0 only
+            # for SAC, but an explicit budget means the same thing on
+            # every backend.
+            key, ou, action, out, rows = vector_env_step(
+                cfg, env, E, carry.train.actor_params, carry.env_state,
+                carry.obs, carry.ou, carry.key, scale, offset, low, high,
+                warmup_active=(
+                    carry.size < warmup_uniform
+                    if warmup_uniform > 0
+                    else None
+                ),
             )
             idx = (carry.ptr + jnp.arange(E, dtype=jnp.int32)) % capacity
             storage = carry.storage.at[idx].set(rows)
@@ -229,7 +181,7 @@ class OnDeviceDDPG:
                     train=carry.train,
                     env_state=out.state,
                     obs=out.obs,
-                    ou=jnp.where(out.done[:, None], 0.0, ou),
+                    ou=ou,
                     ep_ret=jnp.where(out.done, 0.0, ep_ret),
                     storage=storage,
                     ptr=(carry.ptr + E) % capacity,

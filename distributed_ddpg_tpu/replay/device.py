@@ -651,6 +651,38 @@ class DeviceReplay:
             return
         self._drain_ring()
 
+    def insert_device_rows(self, rows) -> int:
+        """Land an ALREADY-DEVICE-RESIDENT [M, D] block with the donated
+        jitted scatter — the device-actor path (actors/device_pool.py;
+        docs/DEVICE_ACTORS.md). The rows never touch the host: no staging
+        ring, no transfer-scheduler ingest class, no IngestStats traffic —
+        the devactor_* family accounts for this source instead, and a
+        device-actor-only run reports transfer_ingest_items == 0.
+
+        Multi-host: `rows` must be REPLICATED (NamedSharding P(None, None))
+        and every process must call this at the same loop point — the
+        device-actor rollout is a global SPMD program all processes
+        execute in lockstep, so the replicated storage cannot fork and the
+        host-row sync_ship accounting is untouched. The source-map pointer
+        mirror advances with untracked (-1) tags so host-row attribution
+        (guardrails) stays aligned when both backends feed the ring."""
+        m = int(rows.shape[0])
+        if m == 0:
+            return 0
+        with self.dispatch_lock:
+            old_ptr = self.ptr  # not donated by _insert; PER stamp input
+            self.storage, self.ptr, self.size = self._insert(
+                self.storage, rows, self.ptr, self.size
+            )
+            self._stamp_device_rows(m, old_ptr)
+            self._note_shipped(None, None, m)
+        return m
+
+    def _stamp_device_rows(self, m: int, old_ptr) -> None:
+        """PER hook: DevicePrioritizedReplay stamps the landed rows with
+        the running max priority (every-transition-seen-once rule); the
+        uniform buffer needs nothing."""
+
     def drain_pending(self) -> int:
         """Ship all staged full blocks and block until the inserts have
         executed — the barrier bench/tests use before reading storage.
@@ -1068,6 +1100,14 @@ class DevicePrioritizedReplay(DeviceReplay):
         old_ptr = self.ptr
         super()._ship_global(local_rows, k=k)
         self.priorities = self._get_stamp(self._procs * k * self.block_size)(
+            self.priorities, self.max_priority, old_ptr
+        )
+
+    def _stamp_device_rows(self, m: int, old_ptr) -> None:
+        # Device-actor inserts (insert_device_rows) stamp like every other
+        # source: the running max priority over the landed range, from the
+        # pre-insert pointer.
+        self.priorities = self._get_stamp(m)(
             self.priorities, self.max_priority, old_ptr
         )
 

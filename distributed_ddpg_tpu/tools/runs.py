@@ -297,6 +297,23 @@ def summarize_run(path: str) -> Dict[str, Any]:
             }
     digest["serve"] = serve
 
+    # Device-actor digest (actors/device_pool.py; docs/DEVICE_ACTORS.md):
+    # rows/s and the per-chunk dispatch tails are interval-scoped
+    # (steady + worst interval); env_steps/episodes/restarts are
+    # cumulative (the last value is the total).
+    devactor = {}
+    devactor_keys = sorted(
+        {k for r in train + final for k in r if k.startswith("devactor_")}
+    )
+    for key in devactor_keys:
+        vals = _col(train + final, key)
+        if vals:
+            devactor[key] = {
+                "steady": _tail_mean(vals), "max": max(vals),
+                "last": vals[-1],
+            }
+    digest["devactor"] = devactor
+
     recovery = {}
     for key in RECOVERY_KEYS:
         vals = _col(train + final, key)
@@ -370,6 +387,15 @@ def render_summary(digest: Dict[str, Any]) -> str:
             [
                 [k, v["steady"], v["max"], v["last"]]
                 for k, v in digest["serve"].items()
+            ],
+        ))
+    if digest.get("devactor"):
+        out.append("\n-- device actors (docs/DEVICE_ACTORS.md)")
+        out.append(render_table(
+            ["field", "steady", "max", "last"],
+            [
+                [k, v["steady"], v["max"], v["last"]]
+                for k, v in digest["devactor"].items()
             ],
         ))
     if digest.get("pod"):
@@ -473,6 +499,17 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
                     or "error" in key or "fallback" in key or "depth" in key
                 )
             ))
+    for key in sorted(
+        set(a.get("devactor", {})) | set(b.get("devactor", {}))
+    ):
+        da = a.get("devactor", {}).get(key, {})
+        db = b.get("devactor", {}).get(key, {})
+        # Throughput/episode-return are higher-is-better; dispatch-latency
+        # tails (mean/p50/p95/max) and the restart counter are
+        # lower-is-better.
+        add(key, da.get("steady"), db.get("steady"),
+            lower_better=("_ms" in key or "p95" in key or "p50" in key
+                          or key.endswith("_max") or "restart" in key))
     for key in sorted(set(a.get("pod", {})) | set(b.get("pod", {}))):
         if key == "pod_resume_step_elected":
             continue  # an elected step is context, not a metric to delta

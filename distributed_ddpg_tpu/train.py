@@ -826,6 +826,32 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             f"env step {env_steps_offset}"
         )
 
+    # --- on-device vectorized actors (actors/device_pool.py;
+    # docs/DEVICE_ACTORS.md) ---
+    # config.actor_backend='device': rollouts run as jitted lax.scan
+    # chunks over device_actor_envs vmapped JAX envs and scatter straight
+    # into DeviceReplay's HBM ring (insert_device_rows) — no host staging,
+    # no transfer-scheduler ingest class. Param refresh is a device-side
+    # pointer swap from the learner's LIVE params (set_params — re-swapped
+    # every chunk because the learner's dispatch donates the old state).
+    # Built AFTER the resume block so the uniform-warmup budget nets out
+    # restored progress. The host pool above still runs its num_actors
+    # workers (0 = device-only run) and both sources feed the same ring.
+    device_pool = None
+    if config.actor_backend == "device":
+        from distributed_ddpg_tpu.actors.device_pool import DeviceActorPool
+
+        device_pool = DeviceActorPool(
+            config,
+            mesh=learner.mesh,
+            fault=(
+                fault_plan.site("devactor", "rollout") if fault_plan else None
+            ),
+            warmup_offset=env_steps_offset,
+        )
+        device_pool.set_params(learner.state.actor_params)
+        _beat()  # rollout-program construction survived
+
     # Learner d2h pulls ride the scheduler's inline d2h class: absolute
     # priority (no queueing on the hot path), full transfer_* accounting.
     learner.transfer = transfer_sched
@@ -967,6 +993,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         and config.sync_ship_background
     )
     pending_beat: Dict[str, object] = {"t": None}
+    # Globally-agreed env-step budget cache (multi-host: re-gathered every
+    # 10th loop iteration). A cell, not a loop local, so devactor_step's
+    # ingest gate can read the replica-identical value from after_chunk.
+    cached_global = [0]
 
     def wait_beat() -> None:
         """Gate: resolve the outstanding background beat (if any) before
@@ -1010,6 +1040,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         if serve_server is None:
             return {}
         return {**serve_server.snapshot(), **pool.serve_counters()}
+
+    def devactor_fields() -> Dict[str, float]:
+        """devactor_* observability (metrics.DevActorStats;
+        docs/DEVICE_ACTORS.md) for every train/final record when the
+        device-actor backend is armed — interval rows/s, per-chunk
+        dispatch tails, episode stats, and the bounded-restart counter.
+        Records stay clean on the host backend."""
+        return device_pool.snapshot() if device_pool is not None else {}
 
     def _guard_quarantine_sources() -> None:
         """Bad-row -> ingest-source attribution: fetch the offending
@@ -1155,6 +1193,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             ckpt_lib.discard_above(config.checkpoint_dir, step)
         with phases.phase("refresh"):
             pool.broadcast(learner.actor_params_to_host(), learn_steps)
+        if device_pool is not None:
+            # The restored state is a fresh tree; swap the rollout's live
+            # param pointer so the repaired policy acts immediately.
+            device_pool.set_params(learner.state.actor_params)
         next_refresh = learn_steps + config.param_refresh_every
         last_refresh_t = time.perf_counter()
         # The rebuilt programs recompile at the next dispatch — same
@@ -1331,8 +1373,53 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     def buffer_fill() -> int:
         return len(device_replay) if use_device_replay else len(replay)
 
-    def env_steps() -> int:
+    def host_env_steps() -> int:
+        """Env steps from the HOST pool only (process-local on multi-host
+        — each process drains its own workers)."""
         return env_steps_offset + pool.steps_received
+
+    def env_steps() -> int:
+        n = host_env_steps()
+        if device_pool is not None:
+            # Device-actor steps are GLOBAL production (the rollout is one
+            # SPMD program over the whole mesh), identical on every
+            # process — added once here, never summed across processes.
+            n += device_pool.steps_done
+        return n
+
+    def devactor_step(budget_now: Optional[int] = None) -> int:
+        """One device-actor rollout chunk (actors/device_pool.py), gated
+        by the same ingest-ratio budget the host drain honors. The gate's
+        inputs must be replica-identical on multi-host (every process must
+        dispatch the same global rollout programs in the same order):
+        learn_steps and devactor steps are lockstep, and the env-step
+        basis is the caller-provided globally-agreed budget_now when
+        available, else the cached global gather (multi-host) or the local
+        count (single-process — exact)."""
+        if device_pool is None:
+            return 0
+        if config.max_ingest_ratio > 0.0:
+            allowed = min_fill + config.max_ingest_ratio * learn_steps
+            basis = budget_now
+            if basis is None:
+                basis = cached_global[0] if is_multi else env_steps()
+            # Any remaining allowance admits ONE chunk (bounded overshoot
+            # of rows_per_chunk - 1, the host drain's one-queue-batch
+            # semantics): an all-or-nothing gate would wedge warmup
+            # whenever rows_per_chunk > min_fill — the allowance could
+            # never open because learning hasn't started.
+            if basis >= allowed:
+                return 0
+        if is_multi:
+            # Ordering: a queued background sync_ship beat is a global
+            # device program; the rollout dispatch must not race its
+            # enqueue or per-process device-op order forks (the
+            # docs/TRANSFER.md token protocol). No-op when none pending.
+            wait_beat()
+        with phases.phase("devactor"):
+            rows = device_pool.run_chunk(device_replay)
+        env_timer.tick(rows)
+        return rows
 
     def global_env_steps() -> int:
         """SUM of env steps over processes, all-gathered so every process
@@ -1348,7 +1435,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         from distributed_ddpg_tpu.parallel.multihost import allgather_scalar
 
         def gather() -> int:
-            return int(allgather_scalar(np.int64(env_steps())).sum())
+            # Host-pool steps are per-process (summed); device-actor steps
+            # are already global (one SPMD rollout over the whole mesh,
+            # the same count on every process) — added ONCE, not gathered.
+            total = int(allgather_scalar(np.int64(host_env_steps())).sum())
+            if device_pool is not None:
+                total += device_pool.steps_done
+            return total
 
         if bg_sync:
             return transfer_sched.run_ordered(
@@ -1375,6 +1468,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         nonlocal last_refresh_t, last_log_t
         learn_steps += chunk
         learn_timer.tick(chunk)
+        if device_pool is not None:
+            # Device-actor param refresh: pointer swap to the LIVE params,
+            # re-done every chunk because the dispatch above DONATED the
+            # previous TrainState (the stale tree is deleted — dispatching
+            # a rollout against it would raise). Free: no copy, no d2h.
+            device_pool.set_params(learner.state.actor_params)
         if guard_on and _guardrail_monitor():
             # Rolled back (or numeric-aborted): this chunk's `out` is
             # moot, the rollback already rebroadcast params, and skipping
@@ -1382,6 +1481,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # REPLICATED decision (identical health counters everywhere),
             # so a pod's collective schedule stays aligned.
             return
+        # Device rollout BEFORE the ingest beat: in bg_sync mode
+        # ingest_once issues a background lockstep beat, and enqueuing the
+        # rollout first keeps the per-process device-op order a pure
+        # function of the (lockstep) iteration count.
+        devactor_step()
         ingest_once(sync_wait=False)
 
         if config.prioritized and not use_device_replay:
@@ -1502,6 +1606,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 **guardrail_fields(),
                 # Inference serving (docs/SERVING.md; serve/).
                 **serve_fields(),
+                # Device-actor rollouts (docs/DEVICE_ACTORS.md).
+                **devactor_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -1635,6 +1741,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # force pads a block from sub-block trickles so slow actors
             # still cross the threshold.
             moved = ingest_once(force_ship=(warm_it % 20 == 19))
+            moved += devactor_step()
             _beat()
             pool.monitor()
             if (
@@ -1729,7 +1836,6 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # instead of one per chunk. Overshoot is bounded by 10 chunks
             # of ingest — noise against BASELINE-scale budgets.
             it = 0
-            cached_global = 0
             last_budget = -1
             first_dispatch_done = False
             while not preempt.is_set() and not numeric_failed[0]:
@@ -1743,8 +1849,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     pool.monitor()
                 if is_multi:
                     if it % 10 == 0:
-                        cached_global = global_env_steps()
-                    budget_now = cached_global
+                        cached_global[0] = global_env_steps()
+                    budget_now = cached_global[0]
                 else:
                     budget_now = env_steps()
                 if budget_now >= config.total_env_steps and learn_steps > 0:
@@ -1791,7 +1897,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     # process skips the same iterations and the SPMD
                     # collective schedule stays aligned (same reasoning as
                     # the loop-exit condition above).
-                    if not ingest_once(sync_wait=False):
+                    moved_now = ingest_once(sync_wait=False)
+                    moved_now += devactor_step(budget_now)
+                    if not moved_now:
                         time.sleep(0.002)
                     it += 1
                     continue
@@ -1939,10 +2047,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         eval_policy.load_flat(flatten_params(learner.actor_params_to_host()))
         final_return = _eval_numpy(eval_policy, config, spec)
     rate = learn_timer.rate()
-    # ONE serve snapshot shared by the final record and the returned
-    # summary: ServeStats.snapshot resets the interval reservoirs, so a
-    # second call would report zeroed latency/fill/depth tails.
+    # ONE serve/devactor snapshot shared by the final record and the
+    # returned summary: both stats reset their interval reservoirs at
+    # snapshot, so a second call would report zeroed tails.
     serve_final = serve_fields()
+    devactor_final = devactor_fields()
     log.log(
         "final", env_steps(),
         learner_steps=learn_steps,
@@ -1954,6 +2063,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **pod_fields(),
         **guardrail_fields(),
         **serve_final,
+        **devactor_final,
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the
@@ -1981,6 +2091,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **pod_fields(),
         **guardrail_fields(),
         **serve_final,
+        **devactor_final,
     }
 
 

@@ -30,7 +30,11 @@
 #              -serve_queue_depth_p95 (docs/SERVING.md), which SKIP
 #              against pre-serve baselines and arm automatically once a
 #              BENCH_SERVE=1 bench becomes the baseline — the same
-#              arm-on-first-capture pattern as the transfer p95 keys.
+#              arm-on-first-capture pattern as the transfer p95 keys;
+#              plus the higher-is-better devactor_rows_per_s throughput
+#              pin (docs/DEVICE_ACTORS.md), which SKIPs against
+#              pre-devactor baselines and arms once a BENCH_DEVACTOR=1
+#              bench becomes the baseline.
 #              Keys the BASELINE lacks are SKIPped, so old BENCH_r*.json
 #              baselines gate on value alone and the new pins arm
 #              automatically once a newer bench becomes the baseline; a
@@ -40,7 +44,7 @@ set -euo pipefail
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
 candidate="${1:?usage: ci_gate.sh <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).
