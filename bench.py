@@ -33,7 +33,11 @@ adds the serve-path measurement (served throughput + p50/p95 with a
 per-worker act() A/B at each client count — docs/SERVING.md);
 BENCH_DEVACTOR=1 adds the device-actor rollout A/B (on-device vectorized
 rollouts vs the host-pool path at equal env count E, rows/s curve over E
-— docs/DEVICE_ACTORS.md; BENCH_DEVACTOR_ENVS overrides the E list).
+— docs/DEVICE_ACTORS.md; BENCH_DEVACTOR_ENVS overrides the E list);
+BENCH_SHARDED_REPLAY=1 adds the sharded vs replicated device-replay A/B
+(measured ingest bytes/row + per-device storage bytes + chunk rate on the
+8 virtual devices — docs/REPLAY_SHARDING.md; BENCH_SHARDED_ROWS overrides
+the ingest volume).
 """
 
 from __future__ import annotations
@@ -738,6 +742,110 @@ def phase_devactor() -> dict:
     }
 
 
+def phase_sharded_replay() -> dict:
+    """Sharded vs replicated device-replay A/B (BENCH_SHARDED_REPLAY=1;
+    docs/REPLAY_SHARDING.md) on the 8 virtual CPU devices: the same
+    ingest stream through both placements, reporting
+
+      replay_ingest_bytes_per_row  MEASURED h2d bytes landed per ingested
+                                   row (sum over device copies — the
+                                   1/N-ingest claim; lower-is-better
+                                   ci_gate key)
+      replay_device_storage_bytes  storage bytes ONE device holds (the
+                                   N×-aggregate-capacity claim at fixed
+                                   per-device HBM)
+      grad_steps_per_sec           fused-sampling chunk rate per mode
+                                   (the shard-exchange gather's cost,
+                                   visible next to the byte win)
+
+    plus the derived replay_capacity_ratio (replicated device bytes /
+    sharded device bytes ~= N) and replay_ingest_bytes_ratio at top level.
+    Absolute CPU rates are meaningless; the BYTE ratios are the signal —
+    they are placement facts, not timing."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "2"))
+    mesh = mesh_lib.make_mesh(-1, 1)
+    n_dev = mesh.shape["data"]
+    rows_total = int(os.environ.get("BENCH_SHARDED_ROWS", "32768"))
+    capacity = max(65_536, rows_total)
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=64,
+        fused_chunk="off", replay_capacity=capacity,
+    )
+    rng = np.random.default_rng(0)
+    block = pack_batch_np({
+        "obs": rng.standard_normal((4096, OBS_DIM)).astype(np.float32),
+        "action": rng.uniform(-1, 1, (4096, ACT_DIM)).astype(np.float32),
+        "reward": rng.standard_normal(4096).astype(np.float32),
+        "discount": np.full(4096, 0.99, np.float32),
+        "next_obs": rng.standard_normal((4096, OBS_DIM)).astype(np.float32),
+        "weight": np.ones(4096, np.float32),
+    })
+    modes = {}
+    for mode in ("replicated", "sharded"):
+        replay = DeviceReplay(
+            capacity, OBS_DIM, ACT_DIM, mesh=mesh, block_size=1024,
+            async_ship=False, replay_sharding=mode,
+        )
+        t0 = time.perf_counter()
+        shipped = 0
+        while shipped < rows_total:
+            replay.add_packed(block)
+            shipped += len(block)
+        replay.drain_pending()
+        ingest_s = time.perf_counter() - t0
+        snap = replay.ingest_snapshot()
+        lrn = ShardedLearner(
+            cfg.replace(replay_sharding=mode), OBS_DIM, ACT_DIM,
+            action_scale=1.0, mesh=mesh, chunk_size=32,
+            replay_sharding=mode,
+        )
+        lrn.run_sample_chunk(replay)  # compile
+        t0 = time.perf_counter()
+        steps = 0
+        while time.perf_counter() - t0 < seconds:
+            out = lrn.run_sample_chunk(replay)
+            steps += 32
+        jax.block_until_ready(out.td_errors)
+        rate = steps / (time.perf_counter() - t0)
+        modes[mode] = {
+            "replay_ingest_bytes_per_row": snap["replay_ingest_bytes_per_row"],
+            "replay_device_storage_bytes": snap["replay_device_storage_bytes"],
+            "replay_shard_count": snap["replay_shard_count"],
+            "replay_shard_fill_min": snap["replay_shard_fill_min"],
+            "replay_shard_fill_max": snap["replay_shard_fill_max"],
+            "replay_exchange_ms_p95": snap["replay_exchange_ms_p95"],
+            "ingest_rows_per_s": round(shipped / ingest_s, 1),
+            "grad_steps_per_sec": round(rate, 1),
+        }
+        replay.close()
+    repl, shard = modes["replicated"], modes["sharded"]
+    return {
+        "sharded_replay": {**modes, "n_devices": n_dev},
+        # Top-level gate keys (scripts/ci_gate.sh): the sharded placement's
+        # measured bytes/row (lower-is-better) and the capacity ratio.
+        "replay_ingest_bytes_per_row": shard["replay_ingest_bytes_per_row"],
+        "replay_ingest_bytes_ratio": round(
+            repl["replay_ingest_bytes_per_row"]
+            / max(shard["replay_ingest_bytes_per_row"], 1e-9), 2
+        ),
+        "replay_capacity_ratio": round(
+            repl["replay_device_storage_bytes"]
+            / max(shard["replay_device_storage_bytes"], 1), 2
+        ),
+    }
+
+
 _PHASES = {
     "native": phase_native,
     "probe": phase_probe,
@@ -747,6 +855,7 @@ _PHASES = {
     "study": phase_study,
     "serve": phase_serve,
     "devactor": phase_devactor,
+    "sharded_replay": phase_sharded_replay,
 }
 
 
@@ -1062,6 +1171,26 @@ def main() -> int:
         )
         if dev_res:
             result.update(dev_res)
+        else:
+            errors.append(err)
+
+    # Sharded-replay A/B (BENCH_SHARDED_REPLAY=1; docs/REPLAY_SHARDING.md):
+    # CPU-only on the 8 virtual devices, tunnel-independent. The top-level
+    # replay_ingest_bytes_per_row key arms ci_gate.sh's lower-is-better
+    # sharded-replay pin once this bench becomes the baseline.
+    if os.environ.get("BENCH_SHARDED_REPLAY", "0") == "1" and not study_only:
+        note("sharded-replay bench phase")
+        shard_res, err = _run_phase(
+            "sharded_replay",
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count=8").strip(),
+            },
+            timeout=600,
+        )
+        if shard_res:
+            result.update(shard_res)
         else:
             errors.append(err)
 

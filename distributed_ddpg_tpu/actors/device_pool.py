@@ -320,6 +320,87 @@ class DeviceActorPool:
         )
         return True
 
+    # --- rollout-state checkpointing (docs/DEVICE_ACTORS.md) ---
+
+    def carry_state_dict(self) -> dict:
+        """Host snapshot of the rollout carry — env state, observations,
+        OU noise, per-env episode accumulators, the step/episode counters,
+        and the PRNG key — as flat numpy leaves keyed by tree position
+        (the carry is a fixed NamedTuple for a given config, so position
+        is a stable identity). Rides the checkpoint as a sidecar
+        (checkpoint.py devactor_carry.npz, covered by the manifest) so a
+        resumed device-actor run CONTINUES its E episodes instead of
+        restarting them. One bounded d2h, called at checkpoint cadence
+        only.
+
+        Multi-host with the env axis sharded over processes: no single
+        writer can pull shards it doesn't address — returns None (the
+        checkpoint simply omits the sidecar and a resumed run starts
+        fresh episodes, the pre-PR-10 behavior), same single-writer
+        limitation as the multi-host sharded replay snapshot
+        (docs/REPLAY_SHARDING.md)."""
+        leaves = jax.tree.leaves(self._carry)
+        if any(
+            not getattr(leaf, "is_fully_addressable", True)
+            for leaf in leaves
+        ):
+            return None
+        return {
+            f"leaf_{i}": np.asarray(jax.device_get(leaf))
+            for i, leaf in enumerate(leaves)
+        }
+
+    def load_carry_state(self, state: dict) -> bool:
+        """Restore a carry_state_dict snapshot into the live carry
+        (shape/dtype-validated leaf by leaf). Returns False — with a loud
+        note, episodes then start fresh — when the snapshot does not
+        match this pool's carry tree (changed env, E, or algorithm
+        family): a mismatched resume must degrade to the pre-checkpoint
+        behavior, not crash the run. On success the interval episode
+        mirrors re-sync so the first snapshot() after resume reports
+        deltas, not the whole restored history."""
+        leaves, treedef = jax.tree.flatten(self._carry)
+        restored = []
+        for i, ref in enumerate(leaves):
+            arr = state.get(f"leaf_{i}")
+            if (
+                arr is None
+                or tuple(arr.shape) != tuple(ref.shape)
+                or np.dtype(arr.dtype) != np.dtype(ref.dtype)
+            ):
+                print(
+                    f"[devactor] checkpointed rollout state does not match "
+                    f"this config's carry (leaf {i}: "
+                    f"{None if arr is None else (arr.shape, str(arr.dtype))}"
+                    f" vs {(tuple(ref.shape), str(ref.dtype))}); starting "
+                    "fresh episodes",
+                    file=sys.stderr, flush=True,
+                )
+                return False
+            restored.append(arr)
+        if len(state) > len(leaves):
+            print(
+                "[devactor] checkpointed rollout state has extra leaves; "
+                "starting fresh episodes",
+                file=sys.stderr, flush=True,
+            )
+            return False
+        carry = jax.tree.unflatten(treedef, [jnp.asarray(a) for a in restored])
+        self._carry = jax.device_put(carry, self._carry_sharding)
+        self._eps_seen = int(jax.device_get(self._carry.episodes))
+        self._ret_seen = float(jax.device_get(self._carry.ret_sum))
+        # NOTE: the host step mirror (steps_done) stays at 0 — restored
+        # production is already counted by the trainer's env_steps_offset,
+        # and double-counting would eat the remaining env-step budget. The
+        # DEVICE counter (carry.steps) keeps its cumulative value, which
+        # is exactly what the uniform-warmup gate needs to stay closed.
+        trace.instant(
+            "devactor_carry_restored",
+            steps=int(jax.device_get(self._carry.steps)),
+            episodes=self._eps_seen,
+        )
+        return True
+
     # --- host-side views ---
 
     @property

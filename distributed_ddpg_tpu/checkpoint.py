@@ -286,7 +286,8 @@ def _quarantine_corrupt(directory: str, step: int) -> None:
 
 def _write_once(directory: str, step: int, ckpt: Dict[str, Any],
                 config: Optional[DDPGConfig],
-                keep: int = KEEP_CHECKPOINTS) -> str:
+                keep: int = KEEP_CHECKPOINTS,
+                devactor_state: Optional[Dict[str, Any]] = None) -> str:
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
     # A leftover directory at this step (a corrupt checkpoint restore
     # skipped, or a prior attempt whose sidecar write failed) would make
@@ -296,6 +297,16 @@ def _write_once(directory: str, step: int, ckpt: Dict[str, Any],
         shutil.rmtree(path, ignore_errors=True)
     with _checkpointer() as ckptr:
         ckptr.save(path, ckpt)
+    if devactor_state:
+        # Device-actor rollout carry (actors/device_pool.carry_state_dict;
+        # docs/DEVICE_ACTORS.md): a flat-leaf npz INSIDE the step dir —
+        # written after orbax finalizes and before the manifest walk, so
+        # the manifest's size+crc verification covers it like every orbax
+        # payload file. A sidecar, not an orbax subtree: the carry's tree
+        # shape is env/config-dependent, and restore() must be able to
+        # read it back BEFORE the pool (hence the template) exists.
+        with open(os.path.join(path, "devactor_carry.npz"), "wb") as f:
+            np.savez(f, **devactor_state)
     if config is not None:
         # nan (the v_min/v_max auto sentinel) would serialize as the
         # non-RFC bare `NaN` token — unreadable by jq and strict parsers.
@@ -314,7 +325,8 @@ def _write_once(directory: str, step: int, ckpt: Dict[str, Any],
 def _write(directory: str, step: int, ckpt: Dict[str, Any],
            config: Optional[DDPGConfig], keep: int = KEEP_CHECKPOINTS,
            retries: int = 0, backoff_s: float = 0.5,
-           fault=None) -> Tuple[str, int]:
+           fault=None,
+           devactor_state: Optional[Dict[str, Any]] = None) -> Tuple[str, int]:
     """Write with bounded retry + exponential backoff on OSError (full
     disk blips, NFS hiccups, injected ckpt:write:ioerror faults). Returns
     (path, retries_used). `fault` is a faults.FaultSite ticked once per
@@ -326,7 +338,10 @@ def _write(directory: str, step: int, ckpt: Dict[str, Any],
         try:
             if fault is not None:
                 fault.tick()
-            return _write_once(directory, step, ckpt, config, keep=keep), attempt
+            return _write_once(
+                directory, step, ckpt, config, keep=keep,
+                devactor_state=devactor_state,
+            ), attempt
         except OSError as e:
             # A failed attempt may leave a partially-finalized step dir
             # (or a completed dir whose sidecar write failed) — clear it
@@ -361,10 +376,13 @@ def save(
     retries: int = 0,
     backoff_s: float = 0.5,
     fault=None,
+    devactor_state=None,
 ) -> str:
     """Write checkpoint `directory/step_N` synchronously. Returns the path.
     `retries`/`backoff_s` bound the OSError retry loop (_write); `fault`
-    is an optional faults.FaultSite for the chaos harness."""
+    is an optional faults.FaultSite for the chaos harness.
+    `devactor_state` (actors/device_pool.carry_state_dict) rides as the
+    devactor_carry.npz sidecar inside the step dir."""
     path, _ = _write(
         directory, step,
         _snapshot(step, state, replay, env_steps, v_bounds=v_bounds),
@@ -373,6 +391,7 @@ def save(
         retries=retries,
         backoff_s=backoff_s,
         fault=fault,
+        devactor_state=devactor_state,
     )
     return path
 
@@ -415,9 +434,12 @@ class AsyncSaver:
         retries: int = 0,
         backoff_s: float = 0.5,
         fault=None,
+        devactor_state=None,
     ) -> bool:
         """Snapshot now, write in the background. Returns False (and skips)
-        if the previous write is still in flight."""
+        if the previous write is still in flight. `devactor_state` must
+        already be host-side numpy (device_pool.carry_state_dict pulls it
+        on the caller's thread, same discipline as the state snapshot)."""
         import threading
 
         with self._lock:
@@ -434,7 +456,7 @@ class AsyncSaver:
                         _, used = _write(
                             directory, step, ckpt, config, keep=keep,
                             retries=retries, backoff_s=backoff_s,
-                            fault=fault,
+                            fault=fault, devactor_state=devactor_state,
                         )
                     self.write_retries += used
                 except Exception as e:  # surfaced via .errors / wait()
@@ -635,6 +657,7 @@ def restore(
         # exactly. Probe the saved structure rather than catching ValueError,
         # so genuine template mismatches keep their original diagnostic.
         has_bounds = False
+        has_replay = replay is not None
         try:
             on_disk = ckptr.metadata(path)
             # The saved tree's location varies by orbax version: current
@@ -650,14 +673,39 @@ def restore(
                 tree = on_disk
             has_meta = "meta" in tree
             has_bounds = has_meta and "v_bounds" in tree["meta"]
+            has_replay = "replay" in tree
         except Exception:
             has_meta = True  # metadata unreadable: let restore() report it
         if not has_meta:
             template.pop("meta")  # env_steps then resumes as 0
         elif has_bounds:
             template["meta"]["v_bounds"] = np.zeros(2, np.float64)
+        if not has_replay and replay is not None:
+            # Checkpoints from multi-host SHARDED runs omit replay
+            # contents (no single-writer snapshot spans the shards —
+            # replay/device.py state_dict, docs/REPLAY_SHARDING.md): the
+            # buffer resumes empty and re-warms, loudly.
+            template.pop("replay", None)
+            print(
+                f"[checkpoint] step_{step} carries no replay contents "
+                "(multi-host sharded writer); the buffer resumes empty",
+                file=sys.stderr, flush=True,
+            )
+        elif has_replay and replay is None:
+            # A replay-carrying checkpoint restored without a buffer to
+            # land it in (e.g. a replicated-mode checkpoint resumed by a
+            # multi-host sharded run): orbax needs the template to cover
+            # the on-disk tree, and silently dropping GBs of experience
+            # would mask a placement-mode switch — surface it instead.
+            raise RuntimeError(
+                f"checkpoint step_{step} carries replay contents but this "
+                "run cannot restore them (multi-host sharded replay has "
+                "no single-writer snapshot; docs/REPLAY_SHARDING.md) — "
+                "resume with the original replay placement, or start a "
+                "fresh checkpoint_dir"
+            )
         restored = ckptr.restore(path, template)
-    if replay is not None:
+    if replay is not None and "replay" in restored:
         replay.load_state_dict(restored["replay"])
     state = jax.tree.map(np.asarray, restored["state"])
     meta = restored.get("meta", {})
@@ -666,4 +714,19 @@ def restore(
         if "v_bounds" in meta:
             vb = np.asarray(meta["v_bounds"], np.float64)
             meta_out["v_bounds"] = (float(vb[0]), float(vb[1]))
+        carry_path = os.path.join(path, "devactor_carry.npz")
+        if os.path.exists(carry_path):
+            # Device-actor rollout carry sidecar (save's devactor_state):
+            # handed back as host arrays — the pool that consumes it is
+            # built AFTER restore (its warmup budget needs env_steps), so
+            # it cannot contribute a template here.
+            try:
+                with np.load(carry_path) as z:
+                    meta_out["devactor_carry"] = {k: z[k] for k in z.files}
+            except (OSError, ValueError) as e:
+                print(
+                    f"[checkpoint] devactor_carry.npz unreadable ({e!r}); "
+                    "rollout state starts fresh",
+                    file=sys.stderr, flush=True,
+                )
     return state, step, env_steps

@@ -109,6 +109,23 @@ class DDPGConfig:
     # device memory; the device path (uniform AND prioritized) is the
     # flagship zero-h2d steady state.
     host_replay: bool = False
+    # Device-replay placement (replay/device.py; docs/REPLAY_SHARDING.md).
+    # "replicated" (default): every device holds an identical copy kept
+    # bit-identical via lockstep sync_ship — aggregate capacity equals ONE
+    # device's HBM, every ingested row is copied to all N devices, and
+    # this mode stays the bit-exact parity oracle. "sharded": the same
+    # logical ring partitioned over the mesh's 'data' axis (strided
+    # ownership — position p on shard p % N), so per-device storage is
+    # capacity/N rows (~N× aggregate capacity at fixed HBM) and each
+    # staged row is shipped only to its owner (~1/N landed ingest bytes,
+    # the BENCH_SHARDED_REPLAY A/B headline). Sampling draws replica-
+    # identical indices and reassembles the minibatch with an owner-masked
+    # gather + psum inside the jitted chunk; sampled minibatches are
+    # bit-identical to replicated mode. Forces the XLA scan path (the
+    # megakernel reads replicated storage whole) and requires
+    # model_axis=1; multi-host sharded runs omit replay contents from
+    # checkpoints (no single-writer snapshot spans the shards).
+    replay_sharding: str = "replicated"
     # Device-replay ingest pipeline (replay/device.py; docs/INGEST.md).
     # ingest_async moves single-process host->HBM shipping onto a
     # background shipper thread (bounded by the staging ring; a full ring
@@ -545,6 +562,65 @@ class DDPGConfig:
             )
         if self.ingest_coalesce < 1:
             raise ValueError("ingest_coalesce must be >= 1")
+        if self.replay_sharding not in ("replicated", "sharded"):
+            raise ValueError(
+                f"replay_sharding must be 'replicated' or 'sharded', got "
+                f"{self.replay_sharding!r}"
+            )
+        if self.replay_sharding == "sharded":
+            if self.backend != "jax_tpu":
+                raise ValueError(
+                    "replay_sharding='sharded' partitions the DeviceReplay "
+                    "HBM ring over the jax_tpu mesh; the native/ondevice "
+                    "backends have no sharded ring"
+                )
+            if self.host_replay:
+                raise ValueError(
+                    "replay_sharding='sharded' shards the DEVICE replay; "
+                    "host_replay has no device ring to shard — disable one"
+                )
+            if self.fused_chunk == "on":
+                raise ValueError(
+                    "replay_sharding='sharded' forces the XLA scan path "
+                    "(the Pallas megakernel reads replicated storage "
+                    "whole) — incompatible with fused_chunk='on'; use "
+                    "'auto' (degrades to scan) or 'off'"
+                )
+            if self.model_axis != 1:
+                raise ValueError(
+                    "replay_sharding='sharded' shards over the 'data' "
+                    "axis only; model_axis must be 1 (TP composition is a "
+                    "ROADMAP follow-on)"
+                )
+            if self.data_axis > 0:
+                # Mesh-dependent alignment checks run again at replay
+                # construction with the ACTUAL device count; with an
+                # explicit data_axis they can fail fast at parse.
+                if self.replay_capacity % self.data_axis:
+                    raise ValueError(
+                        f"replay_capacity {self.replay_capacity} must "
+                        f"divide evenly over data_axis={self.data_axis} "
+                        "shards (replay_sharding='sharded')"
+                    )
+                if self.actor_backend == "device":
+                    from distributed_ddpg_tpu.actors.device_pool import (
+                        resolve_device_actor_chunk,
+                    )
+
+                    rows = (
+                        self.device_actor_envs
+                        * resolve_device_actor_chunk(self)
+                    )
+                    if rows % self.data_axis:
+                        raise ValueError(
+                            f"one device-actor chunk produces {rows} rows, "
+                            f"which do not divide over data_axis="
+                            f"{self.data_axis} replay shards — sharded "
+                            "mode requires every insert_device_rows "
+                            "scatter to move a multiple of the shard "
+                            "count (keeps the ring pointer shard-aligned)."
+                            " Adjust device_actor_envs/device_actor_chunk"
+                        )
         if self.policy_delay < 1:
             raise ValueError("policy_delay must be >= 1")
         if self.target_noise < 0 or self.target_noise_clip < 0:

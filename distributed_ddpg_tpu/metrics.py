@@ -274,6 +274,86 @@ class IngestStats:
         return out
 
 
+class ReplayShardStats:
+    """Thread-safe counters for the device-replay placement layer
+    (replay/device.py; docs/REPLAY_SHARDING.md) — the `replay_*` family
+    every train/bench record carries on the device-replay path, and the
+    BENCH_SHARDED_REPLAY A/B's raw input. Byte counters are MEASURED from
+    the device_put result's addressable shards (one copy per replica in
+    replicated mode, exactly one owner copy in sharded mode), so the
+    bytes-per-row headline is an observation, not arithmetic:
+
+      replay_ingest_bytes          h2d bytes landed on devices this
+                                   interval (sum over device copies)
+      replay_ingest_bytes_per_row  interval mean landed bytes per row —
+                                   ~width*4*N replicated, ~width*4
+                                   sharded (the 1/N ingest claim; the
+                                   ci_gate lower-is-better key)
+      replay_shard_count           gauge: storage shards (1 = replicated)
+      replay_device_storage_bytes  gauge: storage bytes ONE device holds
+                                   (capacity*width*4/N sharded — the N×
+                                   aggregate-capacity claim at fixed HBM)
+      replay_shard_fill_min/max    gauge: live rows on the emptiest/
+                                   fullest shard (strided ownership keeps
+                                   them within 1 of each other)
+      replay_exchange_ms_p50/p95   interval ship-dispatch tails (the
+                                   shard-exchange latency signal)
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self._rows = 0
+        self._bytes = 0
+        self._res = _Reservoir(
+            PhaseTimers.RESERVOIR_K,
+            (zlib.crc32(b"replay_exchange") ^ self._seed) & 0x7FFFFFFF,
+        )
+
+    def record_ship(self, rows: int, nbytes: int, dur_s: float) -> None:
+        with self._lock:
+            self._rows += int(rows)
+            self._bytes += int(nbytes)
+            self._res.add(dur_s)
+
+    def snapshot(
+        self,
+        n_shards: int = 1,
+        device_storage_bytes: int = 0,
+        fill: int = 0,
+        reset: bool = True,
+    ) -> Dict[str, float]:
+        with self._lock:
+            rows = self._rows
+            out = {
+                "replay_ingest_bytes": self._bytes,
+                "replay_ingest_bytes_per_row": (
+                    round(self._bytes / rows, 2) if rows else 0.0
+                ),
+                "replay_shard_count": int(n_shards),
+                "replay_device_storage_bytes": int(device_storage_bytes),
+                # Shard s owns live logical rows {p < fill : p % N == s}.
+                "replay_shard_fill_min": (
+                    int(fill) // int(n_shards) if n_shards else 0
+                ),
+                "replay_shard_fill_max": (
+                    -(-int(fill) // int(n_shards)) if n_shards else 0
+                ),
+                "replay_exchange_ms_p50": round(
+                    1000.0 * self._res.percentile(0.50), 3
+                ),
+                "replay_exchange_ms_p95": round(
+                    1000.0 * self._res.percentile(0.95), 3
+                ),
+            }
+            if reset:
+                self._reset_locked()
+        return out
+
+
 class DevActorStats:
     """Counters for the device-actor subsystem (actors/device_pool.py;
     docs/DEVICE_ACTORS.md) — the `devactor_*` family every train/final
@@ -376,7 +456,9 @@ class TransferStats:
     # d2h runs inline on the caller thread (scheduler.run_inline) but is
     # accounted identically; it is excluded from transfer_dispatches,
     # which counts the SCHEDULED classes the dispatch thread executed.
-    SCHEDULED = ("lockstep", "ingest", "prefetch", "serve")
+    # shard_exchange rides the lockstep deque (one ordered lane) but is
+    # accounted as its own class (docs/REPLAY_SHARDING.md).
+    SCHEDULED = ("lockstep", "shard_exchange", "ingest", "prefetch", "serve")
     CLASSES = SCHEDULED + ("d2h",)
 
     def __init__(self, seed: int = 0):

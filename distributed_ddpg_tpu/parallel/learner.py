@@ -87,9 +87,20 @@ class ShardedLearner:
         mode: str = "auto",
         chunk_size: int = 1,
         unroll: int = 4,
+        replay_sharding: str = "replicated",
     ):
         if mode not in ("auto", "explicit"):
             raise ValueError(f"mode must be 'auto' or 'explicit', got {mode!r}")
+        if replay_sharding not in ("replicated", "sharded"):
+            raise ValueError(
+                f"replay_sharding must be 'replicated' or 'sharded', got "
+                f"{replay_sharding!r}"
+            )
+        # Sharded device replay (docs/REPLAY_SHARDING.md): the sampling
+        # chunk programs take storage partitioned over 'data' (strided
+        # ownership) and reassemble each replica-identical index draw into
+        # the global minibatch with a masked-gather + psum exchange.
+        self._replay_sharded = replay_sharding == "sharded"
         self.config = config
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
             config.data_axis, config.model_axis
@@ -296,9 +307,39 @@ class ShardedLearner:
             )
             return key, idx
 
+        # Row gather behind every sampling path. Replicated storage: a
+        # plain local gather. Sharded storage (docs/REPLAY_SHARDING.md):
+        # indices are drawn replica-identically (same key on every
+        # device), then each shard gathers the rows IT owns (logical
+        # position p lives on shard p % N at local slot p // N) and a
+        # psum — each row has exactly one owner, everyone else
+        # contributes zeros, and x + 0.0 is exact in f32 — reassembles
+        # the replicated minibatch: the index-exchange that replaces the
+        # replicated copy. Same indices + same logical row contents =>
+        # the sampled minibatch is BIT-IDENTICAL to replicated mode (the
+        # parity oracle in tests/test_replay_sharding.py).
+        n_shards = self.data_size
+
+        def gather_rows(storage, idx):
+            if not self._replay_sharded:
+                return storage[idx]
+
+            def body(st, ix):
+                s = jax.lax.axis_index("data")
+                owner = ix % n_shards
+                rows = st[jnp.where(owner == s, ix // n_shards, 0)]
+                return jax.lax.psum(
+                    jnp.where((owner == s)[..., None], rows, 0.0), "data"
+                )
+
+            return mesh_lib.shard_map(
+                body, self.mesh,
+                in_specs=(P("data", None), P()), out_specs=P(),
+            )(storage, idx)
+
         def draw_chunk(key, storage, size):
             key, idx = draw_chunk_idx(key, size)
-            return key, storage[idx]
+            return key, gather_rows(storage, idx)
 
         def sample_chunk_fn(s: TrainState, key, storage, size):
             key, packed = draw_chunk(key, storage, size)
@@ -322,6 +363,10 @@ class ShardedLearner:
             # megakernel has no slot for it, so the scan path wins
             # (config validation rejects fused_chunk='on' + guardrails).
             and not config.guardrails
+            # Sharded replay: the kernel reads replicated storage whole;
+            # the shard-exchange gather lives in the XLA scan path only
+            # (config validation rejects fused_chunk='on' + sharded).
+            and not self._replay_sharded
             and self.mode == "auto"
             and fused_chunk_lib.supported(config)
             and fused_chunk_lib.fits_vmem(config, obs_dim, act_dim)
@@ -378,15 +423,44 @@ class ShardedLearner:
         # the device-resident priority vector, IS-weighted scan, and the
         # (|td|+eps)^alpha scatter update — one dispatch, zero h2d. The
         # priority vector is donated in and handed back updated.
-        from distributed_ddpg_tpu.replay.device import draw_per_indices
+        from distributed_ddpg_tpu.replay.device import (
+            draw_per_indices,
+            make_sharded_per_draw,
+        )
+
+        # Sharded PER (docs/REPLAY_SHARDING.md): shard-local cumsums under
+        # a replicated top-level sampler replace the full-vector cumsum,
+        # and the post-chunk priority scatter routes each update to the
+        # owner shard (drop-mode, exactly one owner per index).
+        per_draw = (
+            make_sharded_per_draw(self.mesh)
+            if self._replay_sharded
+            else draw_per_indices
+        )
+
+        def scatter_prios(priorities, idx_flat, vals_flat):
+            if not self._replay_sharded:
+                return priorities.at[idx_flat].set(vals_flat)
+
+            def body(pr, ix, vals):
+                s = jax.lax.axis_index("data")
+                loc = jnp.where(
+                    ix % n_shards == s, ix // n_shards, pr.shape[0]
+                )
+                return pr.at[loc].set(vals, mode="drop")
+
+            return mesh_lib.shard_map(
+                body, self.mesh,
+                in_specs=(P("data"), P(), P()), out_specs=P("data"),
+            )(priorities, idx_flat, vals_flat)
 
         def per_sample_chunk_fn(s, key, storage, size, priorities, maxp,
                                 beta, alpha, eps):
             key, sub = jax.random.split(key)
-            idx, weights = draw_per_indices(
+            idx, weights = per_draw(
                 sub, priorities, size, (self.chunk_size, batch_size), beta
             )
-            packed = storage[idx]
+            packed = gather_rows(storage, idx)
             packed = jax.lax.with_sharding_constraint(
                 packed, NamedSharding(self.mesh, P(None, "data", None))
             )
@@ -398,12 +472,19 @@ class ShardedLearner:
             )
             out = scan_steps(s, batches)
             new_p = (jnp.abs(out.td_errors) + eps) ** alpha
-            priorities = priorities.at[idx.reshape(-1)].set(new_p.reshape(-1))
+            priorities = scatter_prios(
+                priorities, idx.reshape(-1), new_p.reshape(-1)
+            )
             maxp = jnp.maximum(maxp, new_p.max())
             return out, key, priorities, maxp
 
-        storage_sharding = NamedSharding(self.mesh, P(None, None))
-        prio_sharding = NamedSharding(self.mesh, P(None))
+        storage_sharding = NamedSharding(
+            self.mesh,
+            P("data", None) if self._replay_sharded else P(None, None),
+        )
+        prio_sharding = NamedSharding(
+            self.mesh, P("data") if self._replay_sharded else P(None)
+        )
 
         def _jit_per_chunk(fn):
             return jax.jit(
@@ -556,7 +637,7 @@ class ShardedLearner:
 
             def guard_sample_chunk_fn(s: TrainState, key, storage, size, g):
                 key, idx = draw_chunk_idx(key, size)
-                packed = storage[idx]
+                packed = gather_rows(storage, idx)
                 packed = jax.lax.with_sharding_constraint(
                     packed, NamedSharding(self.mesh, P(None, "data", None))
                 )
@@ -594,11 +675,11 @@ class ShardedLearner:
             def guard_per_sample_chunk_fn(s, key, storage, size, priorities,
                                           maxp, beta, alpha, eps, g):
                 key, sub = jax.random.split(key)
-                idx, weights = draw_per_indices(
+                idx, weights = per_draw(
                     sub, priorities, size, (self.chunk_size, batch_size),
                     beta,
                 )
-                packed = storage[idx]
+                packed = gather_rows(storage, idx)
                 packed = jax.lax.with_sharding_constraint(
                     packed, NamedSharding(self.mesh, P(None, "data", None))
                 )
@@ -618,8 +699,8 @@ class ShardedLearner:
                 # of inheriting NaN priorities that would poison every
                 # later draw.
                 new_p = (jnp.abs(out.td_errors) + eps) ** alpha
-                priorities = priorities.at[idx.reshape(-1)].set(
-                    new_p.reshape(-1)
+                priorities = scatter_prios(
+                    priorities, idx.reshape(-1), new_p.reshape(-1)
                 )
                 maxp = jnp.maximum(maxp, new_p.max())
                 return (

@@ -121,6 +121,46 @@ def pod_deadline_s() -> float:
     return _pod_deadline_s
 
 
+def beat_result_timeout_s(default_s: float = 600.0) -> float:
+    """Outer wait bound for a background lockstep/shard_exchange beat
+    ticket (replay/device.py sync_ship, train.py wait_beat). With the pod
+    deadline armed, the lane's in-flight beat is already bounded by
+    call_with_deadline — so the ticket wait only needs to cover at most
+    one queued beat behind one in-flight beat, plus any active grant
+    window (first-chunk compile) and dispatch slack. A wedge therefore
+    surfaces as a typed failure within a small multiple of
+    pod_collective_timeout_s instead of a hardcoded 10-minute stall;
+    deadline unconfigured (single-process, or 0 = off) keeps the generous
+    `default_s` — there is no peer to lose, only teardown stragglers."""
+    t = _pod_deadline_s
+    if t <= 0:
+        return float(default_s)
+    with _pod_lock:
+        grace = max(0.0, _pod_grace_until - time.monotonic())
+    return 2.0 * t + grace + 30.0
+
+
+def wait_beat_ticket(ticket, label: str = "sync_ship beat"):
+    """Resolve one background ordered-lane beat ticket under the derived
+    deadline (beat_result_timeout_s), converting a TimeoutError into
+    typed PodPeerLost — the ONE owner of the bounded-wait contract for
+    both waiters (replay/device.py sync_ship's synchronous facade and
+    train.py's wait_beat gate), so the timeout policy and the typed-abort
+    message can never drift between them. Returns the beat's result;
+    re-raises the beat's own exception (e.g. the lane deadline's
+    PodPeerLost) unchanged."""
+    timeout = beat_result_timeout_s()
+    try:
+        return ticket.result(timeout=timeout)
+    except TimeoutError as e:
+        raise PodPeerLost(
+            f"background {label} unresolved after {timeout:.0f}s — the "
+            "ordered beat lane is wedged (scheduler stalled or a peer "
+            "process is gone)",
+            reason="timeout",
+        ) from e
+
+
 def call_with_deadline(fn, timeout_s: Optional[float] = None,
                        label: str = "collective"):
     """Run `fn` bounded by the pod collective deadline. timeout_s=None

@@ -42,6 +42,31 @@ via jax.make_array_from_process_local_data sharded over the mesh's 'data'
 axis, and the jitted insert's replicated output sharding makes XLA
 all-gather the block (ICI within host, DCN across) into every replica.
 Single-process keeps the inline fast path; sync_ship degrades to flush.
+
+Sharded placement (replay_sharding='sharded'; docs/REPLAY_SHARDING.md):
+everything above keeps the storage REPLICATED — aggregate replay capacity
+equals ONE device's HBM and every ingested row is copied to all N
+replicas. Sharded mode partitions the SAME logical ring over the mesh's
+'data' axis with strided ownership: logical position p lives on shard
+p % N at local slot p // N (NamedSharding P('data', None) over a permuted
+physical layout), so per-device storage is capacity/N rows (~N× aggregate
+capacity at fixed HBM) and a staged ship device_puts each row ONLY to its
+owner shard (~1/N landed ingest bytes — ReplayShardStats measures it from
+the addressable shards). The ring SEMANTICS are unchanged: ptr/size, the
+insert-position sequence, and every logical row's contents are
+bit-identical to replicated mode (the sharded-vs-replicated parity oracle
+in tests/test_replay_sharding.py pins it), which is what lets replicated
+mode stay the correctness reference the way serial ingest anchored the
+coalesced path. Sampling gathers each device's owned rows back into the
+global minibatch inside the jitted learner chunk (parallel/learner.py's
+masked-gather + psum index exchange). Alignment invariants: capacity and
+block_size divide by N, and every insert moves a multiple of N rows, so
+ptr % N == 0 always holds and per-shard groups stay exactly even.
+Multi-host sharded beats ride the transfer scheduler's shard_exchange
+lane (same strict-FIFO ordering + pod deadline as lockstep) and land via
+an all-gather + owner-masked local scatter — per-device HBM stays 1/N,
+while the DCN wire-byte 1/N (a true all-to-all lowering) is on the
+native-TPU verification backlog (ROADMAP).
 """
 
 from __future__ import annotations
@@ -58,7 +83,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_ddpg_tpu import trace
-from distributed_ddpg_tpu.metrics import IngestStats
+from distributed_ddpg_tpu.metrics import IngestStats, ReplayShardStats
 from distributed_ddpg_tpu.replay.staging import HostStagingRing
 from distributed_ddpg_tpu.transfer import AdaptiveCoalesce, HostBufferPool
 from distributed_ddpg_tpu.types import packed_width
@@ -134,6 +159,7 @@ class DeviceReplay:
         background_sync: bool = False,
         pod_fault=None,
         track_sources: bool = False,
+        replay_sharding: str = "replicated",
     ):
         self.capacity = int(capacity)
         self.obs_dim = obs_dim
@@ -141,10 +167,52 @@ class DeviceReplay:
         self.block_size = int(block_size)
         self.width = packed_width(obs_dim, act_dim)
         self._mesh = mesh
+        if replay_sharding not in ("replicated", "sharded"):
+            raise ValueError(
+                f"replay_sharding must be 'replicated' or 'sharded', got "
+                f"{replay_sharding!r}"
+            )
+        self.sharded = replay_sharding == "sharded"
+        if self.sharded:
+            # Strided ownership (module docstring): logical position p is
+            # owned by shard p % N at local slot p // N. The alignment
+            # invariants below keep ptr % N == 0 through every insert and
+            # wrap, so per-shard ship groups are always exactly even.
+            if mesh is None:
+                raise ValueError(
+                    "replay_sharding='sharded' partitions storage over a "
+                    "mesh; construct the replay with one"
+                )
+            if mesh.shape["model"] != 1:
+                raise ValueError(
+                    "replay_sharding='sharded' shards over the 'data' axis "
+                    "only; model_axis must be 1 (TP composition is a "
+                    "ROADMAP follow-on)"
+                )
+            self._n_shards = int(mesh.shape["data"])
+            if self.capacity % self._n_shards:
+                raise ValueError(
+                    f"replay_capacity {self.capacity} must divide evenly "
+                    f"over {self._n_shards} shards (mod-capacity wraparound "
+                    "must preserve the position's owner residue)"
+                )
+            if self.block_size % self._n_shards:
+                raise ValueError(
+                    f"block_size {self.block_size} must divide evenly over "
+                    f"{self._n_shards} shards (each ship lands rows on "
+                    "every owner in exactly even groups)"
+                )
+            self._shard_cap = self.capacity // self._n_shards
+        else:
+            self._n_shards = 1
+            self._shard_cap = self.capacity
         sharding = (
-            NamedSharding(mesh, P(None, None)) if mesh is not None else None
+            NamedSharding(mesh, P("data", None) if self.sharded else P(None, None))
+            if mesh is not None
+            else None
         )
         scalar_sharding = NamedSharding(mesh, P()) if mesh is not None else None
+        self._storage_sharding = sharding
         self.storage = jnp.zeros((self.capacity, self.width), jnp.float32)
         self.ptr = jnp.zeros((), jnp.int32)
         self.size = jnp.zeros((), jnp.int32)
@@ -152,6 +220,11 @@ class DeviceReplay:
             self.storage = jax.device_put(self.storage, sharding)
             self.ptr = jax.device_put(self.ptr, scalar_sharding)
             self.size = jax.device_put(self.size, scalar_sharding)
+        # Placement-layer observability (metrics.ReplayShardStats): landed
+        # h2d bytes are MEASURED from each ship's addressable shards, so
+        # the bytes-per-row A/B headline (docs/REPLAY_SHARDING.md) is an
+        # observation of what this process actually moved.
+        self._shard_stats = ReplayShardStats(seed=seed)
 
         # --- ingest pipeline state (docs/INGEST.md) ---
         # Staging ring + condition: producers push under it, the shipper /
@@ -206,8 +279,16 @@ class DeviceReplay:
 
         # One jitted program per super-block shape; shapes are restricted
         # to power-of-two multiples of block_size (_coalesce_k), so the
-        # jit cache holds at most log2(max_coalesce)+1 entries.
-        self._insert = donate(_insert_impl)
+        # jit cache holds at most log2(max_coalesce)+1 entries. In sharded
+        # mode the replicated-storage program is never built — the
+        # per-shard scatter caches below replace it (same bounded set of
+        # shapes, one program per m).
+        self._insert = None if self.sharded else donate(_insert_impl)
+        if self.sharded:
+            self._block_sharding_sharded = NamedSharding(mesh, P("data", None))
+            self._scalar_sharding = scalar_sharding
+            self._insert_grouped_cache = {}
+            self._insert_replrows_cache = {}
 
         # Multi-host ingest (see module docstring): a second compiled insert
         # whose block input is SHARDED over the data axis — each process
@@ -228,6 +309,7 @@ class DeviceReplay:
                 sharding, scalar_sharding, scalar_sharding
             )
             self._insert_global_cache = {}
+            self._insert_global_sharded_cache = {}
 
         # --- unified transfer scheduler integration (docs/TRANSFER.md) ---
         # When a TransferScheduler is attached, single-process async
@@ -301,7 +383,27 @@ class DeviceReplay:
         with self.dispatch_lock:
             size = len(self)
             n = min(size, max_n)
-            if n == size:
+            if self.sharded:
+                # Logical rows live strided across shards: map the sample
+                # (full fill, or the same deterministic stride as the
+                # replicated branch) through the placement and gather.
+                # Same logical rows as replicated mode -> identical
+                # support-sizing decisions (the replica-fork rule below).
+                idx = (
+                    np.arange(size, dtype=np.int64)
+                    if n == size
+                    else np.linspace(0, size - 1, n).astype(np.int64)
+                )
+                cols = np.asarray(
+                    jax.device_get(
+                        jnp.take(
+                            self.storage[:, col : col + 2],
+                            jnp.asarray(self._phys_of_logical(idx)),
+                            axis=0,
+                        )
+                    )
+                )
+            elif n == size:
                 cols = np.asarray(
                     jax.device_get(self.storage[:n, col : col + 2])
                 )
@@ -338,6 +440,18 @@ class DeviceReplay:
         restart count (cumulative, recovery path) rides along."""
         out = self._stats.snapshot(pending_rows=self.pending_rows)
         out["ingest_shipper_restarts"] = self._shipper_restarts
+        # Placement-layer fields (replay_* family, docs/REPLAY_SHARDING.md):
+        # measured landed bytes/row, per-device storage bytes, per-shard
+        # fill, exchange-dispatch tails.
+        out.update(
+            self._shard_stats.snapshot(
+                n_shards=self._n_shards,
+                device_storage_bytes=(
+                    self.capacity * self.width * 4 // self._n_shards
+                ),
+                fill=len(self),
+            )
+        )
         return out
 
     def transfer_snapshot(self) -> dict:
@@ -671,9 +785,25 @@ class DeviceReplay:
             return 0
         with self.dispatch_lock:
             old_ptr = self.ptr  # not donated by _insert; PER stamp input
-            self.storage, self.ptr, self.size = self._insert(
-                self.storage, rows, self.ptr, self.size
-            )
+            if self.sharded:
+                if m % self._n_shards:
+                    raise ValueError(
+                        f"insert_device_rows: {m} rows do not divide over "
+                        f"{self._n_shards} shards — sharded mode requires "
+                        "every insert to move a multiple of the shard "
+                        "count (keeps ptr N-aligned; config.py validates "
+                        "the device-actor chunk shape when data_axis is "
+                        "explicit)"
+                    )
+                self.storage, self.ptr, self.size = (
+                    self._get_insert_replrows(m)(
+                        self.storage, rows, self.ptr, self.size
+                    )
+                )
+            else:
+                self.storage, self.ptr, self.size = self._insert(
+                    self.storage, rows, self.ptr, self.size
+                )
             self._stamp_device_rows(m, old_ptr)
             self._note_shipped(None, None, m)
         return m
@@ -759,8 +889,18 @@ class DeviceReplay:
             # through the scheduler's lockstep lane — with beats possibly
             # queued ahead, a collective that bypassed the lane would
             # execute in a different order on different processes and
-            # mismatch (docs/TRANSFER.md token protocol).
-            return self.sync_ship_begin(force=force).result(timeout=600.0)
+            # mismatch (docs/TRANSFER.md token protocol). The outer wait
+            # is bounded by the CONFIGURED pod deadline (multihost.
+            # wait_beat_ticket — a small multiple of
+            # pod_collective_timeout_s plus any active grant), not a
+            # hardcoded 10 minutes: a wedged lane surfaces as a typed
+            # PodPeerLost on the clean-abort path (exit 76) instead of a
+            # silent stall.
+            from distributed_ddpg_tpu.parallel import multihost
+
+            return multihost.wait_beat_ticket(
+                self.sync_ship_begin(force=force)
+            )
         return self._sync_ship_collective(force)
 
     def sync_ship_begin(self, force: bool = False):
@@ -782,8 +922,12 @@ class DeviceReplay:
                 "TransferScheduler, and a multi-process mesh"
             )
         self._beat += 1
+        # Sharded beats ride the scheduler's shard_exchange class — the
+        # SAME ordered lane (strict FIFO with lockstep, same pod deadline
+        # wrap), separately accounted in transfer_shard_exchange_* so the
+        # exchange cost is visible next to plain lockstep beats.
         return self._sched.submit(
-            "lockstep",
+            "shard_exchange" if self.sharded else "lockstep",
             lambda: self._sync_ship_collective(force),
             label=f"sync_ship_beat_{self._beat}",
         )
@@ -876,6 +1020,166 @@ class DeviceReplay:
                     moved += take
         return moved
 
+    # --- sharded placement (replay_sharding='sharded'; module docstring,
+    # docs/REPLAY_SHARDING.md). Logical ring semantics are identical to
+    # replicated mode; only WHERE each logical row physically lives
+    # changes: position p -> shard p % N, local slot p // N. ---
+
+    def _phys_of_logical(self, p) -> np.ndarray:
+        """Physical storage row of logical ring position(s) p (host-side
+        numpy; the device programs compute the same map inline)."""
+        p = np.asarray(p, np.int64)
+        return (p % self._n_shards) * self._shard_cap + p // self._n_shards
+
+    def _to_logical_rows(self, phys: np.ndarray) -> np.ndarray:
+        """Physical [capacity, ...] array -> logical ring order (the
+        checkpoint wire format, shared with replicated mode so state_dicts
+        roundtrip ACROSS placement modes)."""
+        n, sc = self._n_shards, self._shard_cap
+        return np.ascontiguousarray(
+            phys.reshape(n, sc, *phys.shape[1:]).swapaxes(0, 1)
+            .reshape(phys.shape)
+        )
+
+    def _to_physical_rows(self, logical: np.ndarray) -> np.ndarray:
+        n, sc = self._n_shards, self._shard_cap
+        return np.ascontiguousarray(
+            logical.reshape(sc, n, *logical.shape[1:]).swapaxes(0, 1)
+            .reshape(logical.shape)
+        )
+
+    def _get_insert_grouped(self, m: int):
+        """Compiled sharded insert for an m-row staged ship whose host
+        block was GROUPED by owner shard (_ship orders shard s's rows
+        s-th): the sharded device_put lands each group on exactly its
+        owner, and each shard scatters one contiguous local run — zero
+        collective, 1/N landed bytes. Relies on ptr % N == 0 (module
+        docstring invariant): group s's local slots all start at ptr // N.
+        Cached per m (the same bounded power-of-two set as _insert)."""
+        fn = self._insert_grouped_cache.get(m)
+        if fn is None:
+            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+            n, sc, cap = self._n_shards, self._shard_cap, self.capacity
+
+            def body(st, bl, ptr, size):
+                start = ptr // n
+                slots = (start + jnp.arange(m // n, dtype=jnp.int32)) % sc
+                st = st.at[slots].set(bl)
+                return st, (ptr + m) % cap, jnp.minimum(size + m, cap)
+
+            fn = jax.jit(
+                mesh_lib.shard_map(
+                    body, self._mesh,
+                    in_specs=(P("data", None), P("data", None), P(), P()),
+                    out_specs=(P("data", None), P(), P()),
+                ),
+                donate_argnums=(0,),
+                in_shardings=(
+                    self._storage_sharding, self._block_sharding_sharded,
+                    self._scalar_sharding, self._scalar_sharding,
+                ),
+                out_shardings=(
+                    self._storage_sharding, self._scalar_sharding,
+                    self._scalar_sharding,
+                ),
+            )
+            self._insert_grouped_cache[m] = fn
+        return fn
+
+    def _get_insert_replrows(self, m: int):
+        """Compiled sharded insert for an m-row REPLICATED device block
+        (the device-actor path, insert_device_rows): every shard already
+        holds the whole block, so each just gathers its owned rows
+        (offset j with j % N == shard — ptr-aligned) and scatters them
+        into its contiguous local run. No collective, no host bytes."""
+        fn = self._insert_replrows_cache.get(m)
+        if fn is None:
+            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+            n, sc, cap = self._n_shards, self._shard_cap, self.capacity
+
+            def body(st, rows, ptr, size):
+                s = jax.lax.axis_index("data")
+                mine = rows[s + jnp.arange(m // n, dtype=jnp.int32) * n]
+                start = ptr // n
+                slots = (start + jnp.arange(m // n, dtype=jnp.int32)) % sc
+                st = st.at[slots].set(mine)
+                return st, (ptr + m) % cap, jnp.minimum(size + m, cap)
+
+            fn = jax.jit(
+                mesh_lib.shard_map(
+                    body, self._mesh,
+                    in_specs=(P("data", None), P(), P(), P()),
+                    out_specs=(P("data", None), P(), P()),
+                ),
+                donate_argnums=(0,),
+                in_shardings=(
+                    self._storage_sharding,
+                    NamedSharding(self._mesh, P(None, None)),
+                    self._scalar_sharding, self._scalar_sharding,
+                ),
+                out_shardings=(
+                    self._storage_sharding, self._scalar_sharding,
+                    self._scalar_sharding,
+                ),
+            )
+            self._insert_replrows_cache[m] = fn
+        return fn
+
+    def _get_global_insert_sharded(self, k: int):
+        """Compiled multi-host sharded insert for a k-block lockstep beat:
+        all-gather the process-major arrival block, compute each gathered
+        row's logical target through the SAME per-process interleave math
+        as the replicated path (_get_global_insert), and drop-scatter only
+        the rows this shard owns into its local run. Per-device HBM writes
+        and storage stay 1/N; the all-gather's wire bytes match the
+        replicated beat (a true all-to-all lowering is the ROADMAP
+        follow-on — gloo's CPU backend has no all_to_all to pin it
+        against)."""
+        fn = self._insert_global_sharded_cache.get(k)
+        if fn is None:
+            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+            procs, bs = self._procs, self.block_size
+            n, sc, cap = self._n_shards, self._shard_cap, self.capacity
+
+            def body(st, bl, ptr, size):
+                m = procs * k * bs
+                full = jax.lax.all_gather(bl, "data", axis=0, tiled=True)
+                g = jnp.arange(m, dtype=jnp.int32)
+                if k > 1:
+                    p = g // (k * bs)
+                    j = (g % (k * bs)) // bs
+                    r = g % bs
+                    off = j * (procs * bs) + p * bs + r
+                else:
+                    off = g
+                tgt = (ptr + off) % cap
+                s = jax.lax.axis_index("data")
+                loc = jnp.where((tgt % n) == s, tgt // n, sc)
+                st = st.at[loc].set(full, mode="drop")
+                return st, (ptr + m) % cap, jnp.minimum(size + m, cap)
+
+            fn = jax.jit(
+                mesh_lib.shard_map(
+                    body, self._mesh,
+                    in_specs=(P("data", None), P("data", None), P(), P()),
+                    out_specs=(P("data", None), P(), P()),
+                ),
+                donate_argnums=(0,),
+                in_shardings=(
+                    self._storage_sharding, self._block_sharding,
+                    self._scalar_sharding, self._scalar_sharding,
+                ),
+                out_shardings=(
+                    self._storage_sharding, self._scalar_sharding,
+                    self._scalar_sharding,
+                ),
+            )
+            self._insert_global_sharded_cache[k] = fn
+        return fn
+
     def _get_global_insert(self, k: int):
         """Compiled all-gathering insert for a k-block super-block. The
         global array arrives ordered [proc0's k blocks | proc1's k blocks
@@ -920,25 +1224,64 @@ class DeviceReplay:
     def _ship_global(self, local_rows: np.ndarray, k: int = 1) -> None:
         if self._fault is not None:
             self._fault.tick()
+        t0 = time.perf_counter()
         block = jax.make_array_from_process_local_data(
             self._block_sharding,
             np.ascontiguousarray(local_rows, np.float32),
             (self._procs * k * self.block_size, self.width),
         )
-        self.storage, self.ptr, self.size = self._get_global_insert(k)(
+        insert = (
+            self._get_global_insert_sharded(k)
+            if self.sharded
+            else self._get_global_insert(k)
+        )
+        self.storage, self.ptr, self.size = insert(
             self.storage, block, self.ptr, self.size
+        )
+        # This process's h2d contribution (its own local rows, once); the
+        # collective's cross-device traffic is not host-visible here.
+        self._shard_stats.record_ship(
+            self._procs * k * self.block_size,
+            sum(s.data.nbytes for s in block.addressable_shards),
+            time.perf_counter() - t0,
         )
 
     def _ship(self, chunk: np.ndarray) -> None:
         if self._fault is not None:
             self._fault.tick()
-        if self._mesh is not None:
-            chunk = jax.device_put(
-                chunk, NamedSharding(self._mesh, P(None, None))
+        t0 = time.perf_counter()
+        m = len(chunk)
+        if self.sharded:
+            # Group rows by owner shard (owner of ptr+j is j % N — ptr is
+            # N-aligned) so the sharded device_put lands each row ONLY on
+            # its owner: 1/N of the replicated path's landed bytes, the
+            # measured claim behind BENCH_SHARDED_REPLAY.
+            n = self._n_shards
+            grouped = np.ascontiguousarray(
+                np.asarray(chunk, np.float32)
+                .reshape(m // n, n, self.width)
+                .transpose(1, 0, 2)
+                .reshape(m, self.width)
             )
-        self.storage, self.ptr, self.size = self._insert(
-            self.storage, chunk, self.ptr, self.size
-        )
+            block = jax.device_put(grouped, self._block_sharding_sharded)
+            nbytes = sum(s.data.nbytes for s in block.addressable_shards)
+            self.storage, self.ptr, self.size = self._get_insert_grouped(m)(
+                self.storage, block, self.ptr, self.size
+            )
+        else:
+            if self._mesh is not None:
+                chunk = jax.device_put(
+                    chunk, NamedSharding(self._mesh, P(None, None))
+                )
+                nbytes = sum(
+                    s.data.nbytes for s in chunk.addressable_shards
+                )
+            else:
+                nbytes = m * self.width * 4
+            self.storage, self.ptr, self.size = self._insert(
+                self.storage, chunk, self.ptr, self.size
+            )
+        self._shard_stats.record_ship(m, nbytes, time.perf_counter() - t0)
 
     # --- state for the fused sampling learner path ---
 
@@ -949,8 +1292,20 @@ class DeviceReplay:
 
     def state_dict(self):
         with self.dispatch_lock:
+            if self.sharded and self._procs > 1:
+                raise RuntimeError(
+                    "sharded replay contents span processes and have no "
+                    "single-writer checkpoint yet; train_jax omits replay "
+                    "from checkpoints in multi-host sharded mode "
+                    "(docs/REPLAY_SHARDING.md)"
+                )
             n = len(self)
             storage = np.asarray(jax.device_get(self.storage))
+            if self.sharded:
+                # Checkpoint wire format is LOGICAL ring order — shared
+                # with replicated mode, so state_dicts roundtrip across
+                # placement modes.
+                storage = self._to_logical_rows(storage)
             return {
                 "packed": storage[:n].copy(),
                 "ptr": np.asarray(int(jax.device_get(self.ptr))),
@@ -961,12 +1316,22 @@ class DeviceReplay:
         n = int(state["size"])
         if n > self.capacity:
             raise ValueError(f"checkpointed size {n} exceeds capacity {self.capacity}")
-        with self.dispatch_lock:
-            storage = np.array(jax.device_get(self.storage))  # writable copy
-            storage[:n] = state["packed"]
-            sharding = (
-                NamedSharding(self._mesh, P(None, None)) if self._mesh is not None else None
+        if self.sharded and self._procs > 1:
+            raise RuntimeError(
+                "sharded replay contents cannot be restored multi-host "
+                "(no single-writer checkpoint; docs/REPLAY_SHARDING.md)"
             )
+        with self.dispatch_lock:
+            if self.sharded:
+                storage = self._to_logical_rows(
+                    np.asarray(jax.device_get(self.storage))
+                )
+                storage[:n] = state["packed"]
+                storage = self._to_physical_rows(storage)
+            else:
+                storage = np.array(jax.device_get(self.storage))  # writable copy
+                storage[:n] = state["packed"]
+            sharding = self._storage_sharding
             self.storage = (
                 jax.device_put(jnp.asarray(storage), sharding)
                 if sharding is not None
@@ -1014,6 +1379,81 @@ def draw_per_indices(key, priorities, size, shape, beta):
     return idx, weights
 
 
+def make_sharded_per_draw(mesh):
+    """Factory for the SHARDED counterpart of draw_per_indices: shard-
+    local priority cumsums with a replicated top-level sampler
+    (docs/REPLAY_SHARDING.md; the 'shard-local trees, replicated root'
+    shape replay/prioritized.py's host sum-tree hints at). Each shard
+    cumsums only its own priority slots; the per-shard masses are
+    all-gathered (N floats — the tiny 'root node' exchange); the
+    stratified uniforms are drawn replica-identically from the same key
+    and each lands in exactly one shard's half-open mass interval
+    (interval bounds come from ONE replicated cumsum of the gathered
+    totals, so no f32 reassociation can double- or zero-claim a sample;
+    the last shard's upper bound is +inf to absorb u==total rounding).
+    The owning shard searches its local cumsum and contributes the
+    LOGICAL index + priority; a psum (each sample has exactly one
+    contributor) replicates them. Same signature and weight formula as
+    draw_per_indices; the sampling distribution matches, the exact index
+    stream does not (different cumsum partition), so the sharded-PER test
+    is statistical where the uniform parity oracle is exact."""
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+    n = mesh.shape["data"]
+
+    def draw(key, priorities, size, shape, beta):
+        k, b = shape
+
+        def body(key, pr, size):
+            sc = pr.shape[0]
+            s = jax.lax.axis_index("data")
+            cum = jnp.cumsum(pr)
+            totals = jax.lax.all_gather(cum[-1], "data")
+            cumtot = jnp.cumsum(totals)
+            total = cumtot[-1]
+            lo = jnp.where(s == 0, 0.0, cumtot[jnp.maximum(s - 1, 0)])
+            hi = jnp.where(s == n - 1, jnp.inf, cumtot[s])
+            u = (
+                jnp.arange(b, dtype=jnp.float32)[None, :]
+                + jax.random.uniform(key, (k, b))
+            ) / b * total
+            mine = (u >= lo) & (u < hi)
+            loc = jnp.searchsorted(
+                cum, (u - lo).reshape(-1), side="right"
+            ).reshape(k, b)
+            # Clamp to this shard's last LIVE slot, not its capacity: a
+            # boundary-rounded u (fl(lo + tot) can exceed lo + cum[-1] by
+            # an ulp, and u == total can reach the last shard) would
+            # otherwise searchsort past the live region and select an
+            # empty zero-priority slot — idx >= size with probs == 0,
+            # whose (size * 1e-12)^-beta IS weight would crush the whole
+            # batch's normalization. The live bound keeps the gathered
+            # priority consistent with the returned index — the sharded
+            # twin of draw_per_indices' jnp.minimum(idx, size - 1). A
+            # shard with zero live rows has tot == 0 and never claims, so
+            # the maximum(., 1) floor is never observable.
+            live = jnp.maximum((size - s + n - 1) // n, 1)
+            loc = jnp.minimum(
+                loc.astype(jnp.int32), jnp.minimum(live - 1, sc - 1)
+            )
+            idx = jax.lax.psum(jnp.where(mine, loc * n + s, 0), "data")
+            p = jax.lax.psum(jnp.where(mine, pr[loc], 0.0), "data")
+            return idx, p, total
+
+        idx, probs_raw, total = mesh_lib.shard_map(
+            body, mesh,
+            in_specs=(P(), P("data"), P()), out_specs=(P(), P(), P()),
+        )(key, priorities, size)
+        probs = probs_raw / jnp.maximum(total, 1e-12)
+        weights = (
+            size.astype(jnp.float32) * jnp.maximum(probs, 1e-12)
+        ) ** (-beta)
+        weights = weights / jnp.max(weights, axis=-1, keepdims=True)
+        return idx, weights
+
+    return draw
+
+
 class DevicePrioritizedReplay(DeviceReplay):
     """Proportional PER with priorities resident in HBM (SURVEY.md §7 hard
     part (a) applied to PER; VERDICT.md round-1 Missing #4).
@@ -1056,7 +1496,15 @@ class DevicePrioritizedReplay(DeviceReplay):
                          block_size=block_size, seed=seed, **kwargs)
         self.alpha = float(alpha)
         self.eps = float(eps)
-        vec_sharding = NamedSharding(mesh, P(None)) if mesh is not None else None
+        # Sharded mode: priorities shard over 'data' with the SAME strided
+        # placement as storage (logical slot p -> shard p % N), so the
+        # scatter/stamp index math is shared and the two arrays can never
+        # disagree about a row's owner.
+        vec_sharding = (
+            NamedSharding(mesh, P("data") if self.sharded else P(None))
+            if mesh is not None
+            else None
+        )
         scalar_sharding = NamedSharding(mesh, P()) if mesh is not None else None
         self._stamp_shardings = (vec_sharding, scalar_sharding)
         self.priorities = jnp.zeros((self.capacity,), jnp.float32)
@@ -1072,6 +1520,37 @@ class DevicePrioritizedReplay(DeviceReplay):
         fn = self._stamp_cache.get(m)
         if fn is None:
             vec_sharding, scalar_sharding = self._stamp_shardings
+
+            if self.sharded:
+                # Sharded stamp: the landed positions are a contiguous
+                # logical run starting at the N-aligned old_ptr, so each
+                # shard stamps its own contiguous m/N local slots — the
+                # priority twin of _get_insert_grouped, no collective.
+                from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+                n, sc = self._n_shards, self._shard_cap
+
+                def stamp_body(prios, maxp, old_ptr):
+                    start = old_ptr // n
+                    slots = (
+                        start + jnp.arange(m // n, dtype=jnp.int32)
+                    ) % sc
+                    return prios.at[slots].set(maxp)
+
+                fn = jax.jit(
+                    mesh_lib.shard_map(
+                        stamp_body, self._mesh,
+                        in_specs=(P("data"), P(), P()),
+                        out_specs=P("data"),
+                    ),
+                    donate_argnums=(0,),
+                    in_shardings=(
+                        vec_sharding, scalar_sharding, scalar_sharding
+                    ),
+                    out_shardings=vec_sharding,
+                )
+                self._stamp_cache[m] = fn
+                return fn
 
             def stamp(prios, maxp, old_ptr):
                 idx = (old_ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
@@ -1133,6 +1612,8 @@ class DevicePrioritizedReplay(DeviceReplay):
             state = super().state_dict()
             n = int(state["size"])
             prios = np.asarray(jax.device_get(self.priorities))
+            if self.sharded:
+                prios = self._to_logical_rows(prios)
             state["priorities"] = prios[:n].copy()
             state["max_priority"] = np.asarray(
                 float(jax.device_get(self.max_priority))
@@ -1145,10 +1626,12 @@ class DevicePrioritizedReplay(DeviceReplay):
             if "priorities" in state:
                 n = int(state["size"])
                 prios = np.array(jax.device_get(self.priorities))
+                if self.sharded:
+                    prios = self._to_logical_rows(prios)
                 prios[:n] = state["priorities"]
-                vec_sharding = (
-                    NamedSharding(self._mesh, P(None)) if self._mesh is not None else None
-                )
+                if self.sharded:
+                    prios = self._to_physical_rows(prios)
+                vec_sharding = self._stamp_shardings[0]
                 scalar = (
                     NamedSharding(self._mesh, P()) if self._mesh is not None else None
                 )

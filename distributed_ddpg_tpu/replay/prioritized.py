@@ -9,6 +9,16 @@ New transitions enter at the current max priority so every transition is
 seen at least once. The learner returns per-sample TD errors from the jitted
 step (learner.py StepOutput) and the host calls `update_priorities` — the
 only extra device->host transfer PER costs.
+
+Device-side siblings (replay/device.py): DevicePrioritizedReplay keeps the
+priority vector in HBM and fuses this module's proportional draw into the
+learner chunk (draw_per_indices); under replay_sharding='sharded' the
+vector partitions over the mesh with the two-level sampler
+make_sharded_per_draw — shard-local cumsums under a replicated top-level
+over per-shard masses, i.e. exactly this sum-tree's root/subtree split
+with the subtrees living on their owner devices (docs/REPLAY_SHARDING.md).
+The host tree here remains the f64 reference the device parity tests
+bound against.
 """
 
 from __future__ import annotations

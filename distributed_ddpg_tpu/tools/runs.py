@@ -314,6 +314,28 @@ def summarize_run(path: str) -> Dict[str, Any]:
             }
     digest["devactor"] = devactor
 
+    # Replay-placement digest (replay/device.py ReplayShardStats;
+    # docs/REPLAY_SHARDING.md): measured ingest bytes/row, per-device
+    # storage bytes, per-shard fill, exchange-dispatch tails.
+    replay_shard = {}
+    replay_keys = sorted(
+        {
+            k
+            for r in train + final
+            for k in r
+            if k.startswith(("replay_shard_", "replay_ingest_bytes",
+                             "replay_exchange_", "replay_device_storage"))
+        }
+    )
+    for key in replay_keys:
+        vals = _col(train + final, key)
+        if vals:
+            replay_shard[key] = {
+                "steady": _tail_mean(vals), "max": max(vals),
+                "last": vals[-1],
+            }
+    digest["replay_sharding"] = replay_shard
+
     recovery = {}
     for key in RECOVERY_KEYS:
         vals = _col(train + final, key)
@@ -396,6 +418,15 @@ def render_summary(digest: Dict[str, Any]) -> str:
             [
                 [k, v["steady"], v["max"], v["last"]]
                 for k, v in digest["devactor"].items()
+            ],
+        ))
+    if digest.get("replay_sharding"):
+        out.append("\n-- replay placement (docs/REPLAY_SHARDING.md)")
+        out.append(render_table(
+            ["field", "steady", "max", "last"],
+            [
+                [k, v["steady"], v["max"], v["last"]]
+                for k, v in digest["replay_sharding"].items()
             ],
         ))
     if digest.get("pod"):
@@ -510,6 +541,17 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
         add(key, da.get("steady"), db.get("steady"),
             lower_better=("_ms" in key or "p95" in key or "p50" in key
                           or key.endswith("_max") or "restart" in key))
+    for key in sorted(
+        set(a.get("replay_sharding", {})) | set(b.get("replay_sharding", {}))
+    ):
+        ra = a.get("replay_sharding", {}).get(key, {})
+        rb = b.get("replay_sharding", {}).get(key, {})
+        # Shard count / fill / per-device storage bytes are placement
+        # facts (context); bytes-per-row and exchange tails are the
+        # lower-is-better costs.
+        add(key, ra.get("steady"), rb.get("steady"),
+            lower_better=("bytes_per_row" in key or "_ms" in key
+                          or "p95" in key or "p50" in key))
     for key in sorted(set(a.get("pod", {})) | set(b.get("pod", {}))):
         if key == "pod_resume_step_elected":
             continue  # an elected step is context, not a metric to delta

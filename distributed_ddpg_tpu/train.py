@@ -582,6 +582,22 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             "(default) or concrete v_min/v_max."
         )
     if (
+        config.replay_sharding == "sharded"
+        and config.distributional
+        and config.v_support_auto
+        and n_proc > 1
+    ):
+        # Same fail-fast discipline: the auto-support reward sampler reads
+        # replay rows host-side (reward_sample), which in sharded mode is
+        # an eager cross-shard gather — not routed through the lockstep
+        # lane, so multi-process it could interleave with queued beats.
+        raise ValueError(
+            "v_min/v_max=auto with replay_sharding='sharded' is not "
+            "supported multi-process: the support sizer's host-side "
+            "reward reads are cross-shard gathers outside the lockstep "
+            "lane. Use replicated replay or concrete v_min/v_max."
+        )
+    if (
         config.max_learn_ratio > 0.0
         and config.max_ingest_ratio > 0.0
         and chunk > (1.0 + config.max_learn_ratio * n_proc) * min_fill
@@ -654,6 +670,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         spec.action_scale,
         spec.action_offset,
         chunk_size=chunk,
+        replay_sharding=config.replay_sharding,
     )
     _beat()  # backend init + learner construction survived
     # Replay lives ON DEVICE (zero h2d in the steady state) for both
@@ -699,6 +716,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             track_sources=(
                 guard_on and config.guardrail_source_offenses > 0
             ),
+            # Placement (docs/REPLAY_SHARDING.md): replicated (parity
+            # oracle) or partitioned over the mesh's data axis.
+            replay_sharding=config.replay_sharding,
         )
         device_replay = (
             DevicePrioritizedReplay(
@@ -714,6 +734,23 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     else:
         device_replay = None
     replay = None if use_device_replay else make_replay(config, spec.obs_dim, spec.act_dim)
+    # Checkpointable replay object. Multi-host SHARDED replay spans
+    # processes — no single writer can snapshot it — so its contents are
+    # omitted from checkpoints (docs/REPLAY_SHARDING.md): learner state,
+    # meta, and the emergency/election contract (exit 76) are unchanged,
+    # and a resumed run re-warms the ring.
+    sharded_multi = is_multi and config.replay_sharding == "sharded"
+    if sharded_multi and jax.process_index() == 0:
+        print(
+            "[replay] multi-host sharded mode: replay contents are "
+            "omitted from checkpoints (docs/REPLAY_SHARDING.md)",
+            file=sys.stderr, flush=True,
+        )
+
+    def ckpt_replay():
+        if sharded_multi:
+            return None
+        return device_replay if use_device_replay else replay
     if config.strict_sync:
         # Lockstep debug mode (config.strict_sync): inline deterministic
         # actors — same surface, no processes, no races to win.
@@ -794,12 +831,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 )
         elif ckpt_lib.latest_step(config.checkpoint_dir) is not None:
             do_resume = True
+    ckpt_meta: Dict[str, object] = {}
     if do_resume:
-        ckpt_meta: Dict[str, object] = {}
         restored, step, env_steps_offset = ckpt_lib.restore(
             resume_dir,
             learner.state,
-            device_replay if use_device_replay else replay,
+            ckpt_replay(),
             step=resume_step,
             config=config,
             meta_out=ckpt_meta,
@@ -850,6 +887,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             warmup_offset=env_steps_offset,
         )
         device_pool.set_params(learner.state.actor_params)
+        if "devactor_carry" in ckpt_meta:
+            # Rollout-state resume (docs/DEVICE_ACTORS.md): restore the
+            # pool's env carry + OU state so a resumed device-actor run
+            # CONTINUES its episodes instead of restarting E fresh ones
+            # (shape-validated; a changed E/env falls back to fresh).
+            device_pool.load_carry_state(ckpt_meta["devactor_carry"])
         _beat()  # rollout-program construction survived
 
     # Learner d2h pulls ride the scheduler's inline d2h class: absolute
@@ -1001,12 +1044,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     def wait_beat() -> None:
         """Gate: resolve the outstanding background beat (if any) before
         the next collective-bearing dispatch / replica-state read. The
-        residual non-overlapped cost lands in t_sync_ship_wait_*."""
+        residual non-overlapped cost lands in t_sync_ship_wait_*. The
+        wait is bounded by the CONFIGURED pod deadline (multihost.
+        wait_beat_ticket), and a timeout surfaces as typed PodPeerLost —
+        the clean-abort path — not a raw TimeoutError."""
         t = pending_beat["t"]
         if t is not None:
             pending_beat["t"] = None
             with phases.phase("sync_ship_wait"):
-                t.result(timeout=600.0)
+                multihost.wait_beat_ticket(t)
 
     def transfer_fields() -> Dict[str, float]:
         """transfer_* observability for the JSONL records: scheduler
@@ -1118,7 +1164,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 file=sys.stderr, flush=True,
             )
             saver.errors.clear()
-        replay_obj = device_replay if use_device_replay else replay
+        replay_obj = ckpt_replay()
         # Host-replay path: the prefetcher samples under replay_lock, so
         # the restore's load_state_dict must hold it too (the device
         # replay serializes on its own dispatch lock). Chunks already
@@ -1197,6 +1243,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # The restored state is a fresh tree; swap the rollout's live
             # param pointer so the repaired policy acts immediately.
             device_pool.set_params(learner.state.actor_params)
+            if "devactor_carry" in ckpt_meta:
+                # Roll the rollout state back with the learner: episodes
+                # continue from the restored point, not from E resets.
+                device_pool.load_carry_state(ckpt_meta["devactor_carry"])
         next_refresh = learn_steps + config.param_refresh_every
         last_refresh_t = time.perf_counter()
         # The rebuilt programs recompile at the next dispatch — same
@@ -1629,8 +1679,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             with phases.phase("ckpt"):
                 saver.save_async(
                     config.checkpoint_dir, learn_steps, learner.state,
-                    device_replay if use_device_replay else replay, config,
+                    ckpt_replay(), config,
                     env_steps=env_steps(),
+                    devactor_state=(
+                        device_pool.carry_state_dict()
+                        if device_pool is not None
+                        else None
+                    ),
                     v_bounds=(
                         (learner.config.v_min, learner.config.v_max)
                         if config.distributional and config.v_support_auto
@@ -1685,9 +1740,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                     ckpt_lib.save(
                         my_dir, learn_steps,
                         learner.state,
-                        device_replay if use_device_replay else replay,
+                        ckpt_replay(),
                         config,
                         env_steps=env_steps(),
+                        devactor_state=(
+                            device_pool.carry_state_dict()
+                            if device_pool is not None
+                            else None
+                        ),
                         v_bounds=(
                             (learner.config.v_min, learner.config.v_max)
                             if config.distributional
@@ -2058,6 +2118,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         learner_steps_per_sec=rate,
         final_return=final_return,
         **recovery_fields(),
+        # Ingest + replay-placement families (replay/device.py): short
+        # runs can finish inside one log cadence, and the final record is
+        # where tools.runs reads the placement facts (shard count,
+        # bytes/row) regardless.
+        **(
+            device_replay.ingest_snapshot()
+            if use_device_replay and device_replay is not None
+            else {}
+        ),
         **phases.snapshot(),
         **transfer_fields(),
         **pod_fields(),

@@ -76,8 +76,19 @@ INGEST = "ingest"
 PREFETCH = "prefetch"
 SERVE = "serve"
 D2H = "d2h"
+# Sharded-replay beat exchanges (replay_sharding='sharded';
+# docs/REPLAY_SHARDING.md): an ORDERED item type that shares the lockstep
+# lane's deque — strict FIFO ACROSS both classes (a shard-exchange beat
+# and a plain lockstep collective must never reorder against each other:
+# both are global device programs whose per-process issue order is the
+# pod's correctness invariant) — and the same pod-deadline wrap, but its
+# own transfer_shard_exchange_* accounting so exchange cost is visible
+# next to ordinary beats.
+SHARD_EXCHANGE = "shard_exchange"
 
-_QUEUED_CLASSES = (LOCKSTEP, INGEST, PREFETCH, SERVE)
+_QUEUED_CLASSES = (LOCKSTEP, INGEST, PREFETCH, SERVE, SHARD_EXCHANGE)
+# Classes sharing the strict-FIFO ordered lane (one deque, LOCKSTEP's).
+_ORDERED_CLASSES = (LOCKSTEP, SHARD_EXCHANGE)
 _FAIR_CLASSES = (INGEST, PREFETCH, SERVE)
 
 
@@ -165,7 +176,11 @@ class TransferScheduler:
         self._max_restarts = int(max_restarts)
         self.restarts = 0
         self._cv = threading.Condition()
-        self._queues: Dict[str, deque] = {c: deque() for c in _QUEUED_CLASSES}
+        # SHARD_EXCHANGE items enqueue into the LOCKSTEP deque (see the
+        # class-constant note): one ordered lane, two accounted classes.
+        self._queues: Dict[str, deque] = {
+            c: deque() for c in (LOCKSTEP,) + _FAIR_CLASSES
+        }
         # Start-time fair queuing state: per-class virtual time advanced by
         # bytes/weight on dispatch; an empty class re-enters at the global
         # virtual time so idle periods never bank starvation-scale credit.
@@ -243,7 +258,7 @@ class TransferScheduler:
                 ) from self._dead_exc
             if self._stop:
                 raise TransferError("transfer scheduler closed")
-            q = self._queues[cls]
+            q = self._queues[LOCKSTEP if cls == SHARD_EXCHANGE else cls]
             if cls in self._vt and not q:
                 # Class re-enters the fair queue at the current virtual
                 # time (see module docstring).
@@ -322,7 +337,7 @@ class TransferScheduler:
         t0 = time.perf_counter()
         try:
             with trace.span(f"transfer_{item.cls}", label=item.ticket.label):
-                if item.cls == LOCKSTEP and self._lockstep_timeout_s > 0:
+                if item.cls in _ORDERED_CLASSES and self._lockstep_timeout_s > 0:
                     from distributed_ddpg_tpu.parallel import multihost
 
                     ret = multihost.call_with_deadline(
@@ -373,7 +388,9 @@ class TransferScheduler:
             )
             with self._cv:
                 if item is not None and not item.ticket.done():
-                    self._queues[item.cls].appendleft(item)
+                    self._queues[
+                        LOCKSTEP if item.cls == SHARD_EXCHANGE else item.cls
+                    ].appendleft(item)
             self._thread = threading.Thread(
                 target=self._run, daemon=True, name="transfer-sched"
             )

@@ -34,7 +34,14 @@
 #              plus the higher-is-better devactor_rows_per_s throughput
 #              pin (docs/DEVICE_ACTORS.md), which SKIPs against
 #              pre-devactor baselines and arms once a BENCH_DEVACTOR=1
-#              bench becomes the baseline.
+#              bench becomes the baseline;
+#              plus the lower-is-better replay_ingest_bytes_per_row pin
+#              (docs/REPLAY_SHARDING.md), which SKIPs against
+#              pre-sharded-replay baselines and arms once a
+#              BENCH_SHARDED_REPLAY=1 bench becomes the baseline — a
+#              candidate whose sharded placement lands MORE bytes per
+#              ingested row than the baseline's is a placement
+#              regression, not noise.
 #              Keys the BASELINE lacks are SKIPped, so old BENCH_r*.json
 #              baselines gate on value alone and the new pins arm
 #              automatically once a newer bench becomes the baseline; a
@@ -44,7 +51,7 @@ set -euo pipefail
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
 candidate="${1:?usage: ci_gate.sh <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).

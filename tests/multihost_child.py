@@ -166,6 +166,10 @@ def run_pod_train(pid: int, tag: str) -> None:
         # emergency one — the resume election must pick among several.
         checkpoint_every=int(os.environ.get("POD_CKPT_EVERY", "64")),
         faults=os.environ.get("POD_FAULTS", ""),
+        # Sharded device replay (docs/REPLAY_SHARDING.md): the sharded-
+        # mode chaos run drives the SAME pod contract over the
+        # shard_exchange beat lane (POD_REPLAY_SHARDING=sharded).
+        replay_sharding=os.environ.get("POD_REPLAY_SHARDING", "replicated"),
         pod_collective_timeout_s=float(os.environ.get("POD_TIMEOUT_S", "20")),
         pod_startup_grace_s=float(
             os.environ.get("POD_STARTUP_GRACE_S", "240")

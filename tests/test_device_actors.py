@@ -380,3 +380,128 @@ def test_tools_runs_devactor_digest(tmp_path):
     assert "device actors" in text and "devactor_rows_per_s" in text
     out, rows = compare_runs(str(path), str(path))
     assert any(r[0] == "devactor_rows_per_s" for r in rows)
+
+
+# --------------------------------------------------------------------------
+# rollout-state checkpointing (ISSUE-10 satellite; docs/DEVICE_ACTORS.md)
+# --------------------------------------------------------------------------
+
+
+def test_carry_state_roundtrip_continues_episodes():
+    """carry_state_dict -> load_carry_state must resume the EXACT rollout
+    stream: a restored pool's next chunk produces bit-identical rows to
+    the uninterrupted pool's (env state, obs, OU state, and the PRNG key
+    all ride the snapshot), and the episode accumulators carry over."""
+    cfg = _small_cfg()
+    mesh = _one_device_mesh()
+    pool_a, params = _pool_with_params(cfg, mesh)
+    rep_a = DeviceReplay(4096, pool_a.obs_dim, pool_a.act_dim, mesh=mesh,
+                         block_size=64, async_ship=False)
+    pool_a.run_chunk(rep_a)
+    pool_a.run_chunk(rep_a)
+    snap = pool_a.carry_state_dict()
+    assert all(isinstance(v, np.ndarray) for v in snap.values())
+
+    pool_b = DeviceActorPool(cfg, mesh=mesh)
+    pool_b.set_params(params)
+    assert pool_b.load_carry_state(snap) is True
+    # Both pools now advance from the identical carry: next chunks match.
+    rep_cont = DeviceReplay(4096, pool_a.obs_dim, pool_a.act_dim, mesh=mesh,
+                            block_size=64, async_ship=False)
+    rep_rest = DeviceReplay(4096, pool_a.obs_dim, pool_a.act_dim, mesh=mesh,
+                            block_size=64, async_ship=False)
+    pool_a.run_chunk(rep_cont)
+    pool_b.run_chunk(rep_rest)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(rep_cont.storage)),
+        np.asarray(jax.device_get(rep_rest.storage)),
+    )
+    # Device-side cumulative counters carried over (warmup gate input);
+    # the host budget mirror deliberately did NOT (env_steps_offset owns
+    # restored production).
+    assert int(jax.device_get(pool_b._carry.steps)) == 3 * E * K
+    assert pool_b.steps_done == E * K
+
+
+def test_carry_state_mismatch_degrades_to_fresh():
+    cfg = _small_cfg()
+    mesh = _one_device_mesh()
+    pool, _ = _pool_with_params(cfg, mesh)
+    snap = pool.carry_state_dict()
+    other = DeviceActorPool(_small_cfg(device_actor_envs=2 * E), mesh=mesh)
+    assert other.load_carry_state(snap) is False  # shape mismatch: E differs
+    assert other.load_carry_state({}) is False    # empty snapshot
+
+
+def test_checkpoint_carries_devactor_sidecar(tmp_path):
+    """checkpoint.save(devactor_state=...) writes devactor_carry.npz
+    inside the step dir (manifest-covered), and restore() hands it back
+    through meta_out — readable BEFORE the pool exists, the resume-order
+    constraint train_jax lives under."""
+    from distributed_ddpg_tpu import checkpoint as ckpt_lib
+
+    cfg = _small_cfg()
+    mesh = _one_device_mesh()
+    pool, _ = _pool_with_params(cfg, mesh)
+    rep = DeviceReplay(4096, pool.obs_dim, pool.act_dim, mesh=mesh,
+                       block_size=64, async_ship=False)
+    pool.run_chunk(rep)
+    state = init_train_state(cfg, pool.obs_dim, pool.act_dim, cfg.seed)
+    d = str(tmp_path)
+    ckpt_lib.save(d, 7, state, rep, cfg, env_steps=E * K,
+                  devactor_state=pool.carry_state_dict())
+    import os
+
+    assert os.path.exists(os.path.join(d, "step_7", "devactor_carry.npz"))
+    ok, why = ckpt_lib.verify_checkpoint(d, 7)
+    assert ok, why
+    meta = {}
+    _, step, env_steps = ckpt_lib.restore(d, state, rep, meta_out=meta)
+    assert step == 7 and env_steps == E * K
+    assert "devactor_carry" in meta
+    fresh = DeviceActorPool(cfg, mesh=mesh)
+    assert fresh.load_carry_state(meta["devactor_carry"]) is True
+    assert int(jax.device_get(fresh._carry.steps)) == E * K
+
+
+@pytest.mark.slow
+def test_train_resume_restores_rollout_state(tmp_path):
+    """End-to-end satellite acceptance: a checkpointed device-actor run
+    resumed from disk continues its episodes — the restored carry's step
+    counter is live in the resumed pool instead of E fresh resets.
+    Slow-marked (two full train_jax runs); the tier-1 carry tests above
+    pin the same contract at unit scale."""
+    from distributed_ddpg_tpu import checkpoint as ckpt_lib
+    from distributed_ddpg_tpu.train import train_jax
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = _small_cfg(
+        backend="jax_tpu",
+        device_actor_envs=8,
+        device_actor_chunk=4,
+        total_env_steps=1200,
+        replay_min_size=200,
+        replay_capacity=20_000,
+        eval_every=100_000,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_every=8,
+        log_path=str(tmp_path / "a.jsonl"),
+    )
+    out = train_jax(cfg)
+    assert out["learner_steps"] > 0
+    step = ckpt_lib.latest_step(ckpt_dir)
+    assert step is not None
+    import os
+
+    assert os.path.exists(
+        os.path.join(ckpt_dir, f"step_{step}", "devactor_carry.npz")
+    )
+    # Resume with a larger budget: the restored pool must keep counting
+    # from the checkpointed carry (its warmup gate stays closed) and the
+    # run must complete cleanly.
+    out2 = train_jax(cfg.replace(
+        total_env_steps=2 * cfg.total_env_steps,
+        log_path=str(tmp_path / "b.jsonl"),
+    ))
+    assert out2["learner_steps"] >= out["learner_steps"]
+    assert out2["devactor_restarts"] == 0

@@ -531,3 +531,59 @@ def test_three_process_kill_one_chaos_then_common_resume(tmp_path):
         assert vals, f"proc{pid} logged no pod_resume_step_elected"
         elected.append(vals)
     assert all(v == {emergency} for v in elected), (emergency, elected)
+
+
+@pytest.mark.slow
+def test_two_process_kill_one_sharded_replay_exits_pod_degraded(tmp_path):
+    """Sharded-replay chaos (ISSUE 10): the SAME pod kill contract over
+    the shard_exchange beat lane. A 2-process gloo pod runs with
+    replay_sharding='sharded' (storage partitioned over the 4-device
+    mesh, sync_ship beats landing via the all-gather + owner-masked
+    scatter); process 1 SIGKILLs itself at its 3rd steady-state beat. The
+    survivor must exit EXIT_POD_DEGRADED within the deadline and leave a
+    manifest-valid emergency checkpoint — written WITHOUT replay contents
+    (no single-writer snapshot spans the shards), which must not break
+    manifest validity or the exit contract."""
+    from distributed_ddpg_tpu.train import EXIT_POD_DEGRADED
+
+    for attempt in range(3):
+        ckpt_dir = str(tmp_path / f"ckpt{attempt}")
+        log_dir = str(tmp_path / f"logs{attempt}")
+        os.makedirs(log_dir, exist_ok=True)
+        results = _launch_pod(
+            2,
+            {
+                "POD_FAULTS": "pod:1:kill@3",
+                "POD_REPLAY_SHARDING": "sharded",
+                "POD_TIMEOUT_S": "15",
+                "POD_STARTUP_GRACE_S": "120",
+                "POD_CKPT_DIR": ckpt_dir,
+                "POD_LOG_DIR": log_dir,
+                "POD_TOTAL_STEPS": "500000",
+            },
+            timeout=300,
+        )
+        if not _infra_flake(results):
+            break
+    (rc0, out0), (rc1, out1) = results
+    assert rc1 == -signal.SIGKILL, f"proc1 should die by SIGKILL: {rc1}\n{out1}"
+    assert rc0 == EXIT_POD_DEGRADED, f"proc0 rc={rc0}\n{out0}"
+    assert "pod peer lost" in out0, out0
+    assert "emergency checkpoint" in out0, out0
+    # The sharded-mode writer omitted replay contents, loudly, and the
+    # state-only emergency checkpoint still verifies manifest-valid.
+    assert "omitted from checkpoints" in out0, out0
+    steps = ckpt_lib.valid_steps(ckpt_dir)
+    assert steps, "survivor left no manifest-valid emergency checkpoint"
+    ok, why = ckpt_lib.verify_checkpoint(ckpt_dir, max(steps))
+    assert ok, why
+    # Beats rode the shard_exchange class (the survivor's JSONL carries
+    # the accounting) — pinned so a refactor can't silently fold sharded
+    # beats back into plain lockstep.
+    with open(os.path.join(log_dir, "proc0.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.startswith("{")]
+    assert any(
+        r.get("transfer_shard_exchange_items", 0) > 0
+        or r.get("pod_beats", 0) > 0
+        for r in recs
+    ), "no beat accounting in survivor records"

@@ -17,6 +17,10 @@ from distributed_ddpg_tpu.config import DDPGConfig
 _TIME_KEYS = (
     "wall_time", "learner_steps_per_sec", "actor_steps_per_sec",
     "ingest_rows_per_sec", "ingest_stall_ms", "ingest_ship_ms",
+    # Replay-placement dispatch tails (metrics.ReplayShardStats) are
+    # wall-clock like ingest_ship_ms; the placement COUNT fields
+    # (replay_ingest_bytes*, shard count/fill) stay in the contract.
+    "replay_exchange_ms_p50", "replay_exchange_ms_p95",
 )
 
 
