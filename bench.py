@@ -328,6 +328,8 @@ def phase_probe() -> dict:
         # Diagnostics selftest: wedge before device init so the phase
         # deadline's faulthandler dump fires — verifies a real tunnel wedge
         # produces a stack in tpu_error instead of a bare "timeout".
+        # lint: ok(timeout-discipline): this sleep IS the injected hang —
+        # the phase deadline kills it; there is no deadline semantics here
         time.sleep(3600)
     import jax
 

@@ -55,6 +55,12 @@ from distributed_ddpg_tpu.actors.worker import run_worker
 from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs.registry import EnvSpec
 
+# Reap bound for a worker we just terminate()d: long enough for the OS to
+# deliver SIGTERM and tear the process down, short enough that a zombie
+# never stalls the supervision tick. Not a config knob — no healthy run
+# should ever be tuned by how long killing a dead worker takes.
+_TERMINATE_JOIN_S = 2.0
+
 
 class ActorPool:
     def __init__(
@@ -97,7 +103,7 @@ class ActorPool:
         from distributed_ddpg_tpu import native
 
         if config.transport == "shm" and not native.available():
-            raise RuntimeError(
+            raise ValueError(
                 "transport='shm' but the native replay core is unavailable "
                 "(no C++ toolchain?); use transport='queue'"
             )
@@ -525,7 +531,7 @@ class ActorPool:
                     continue
                 if p is not None and p.is_alive():
                     p.terminate()
-                    p.join(timeout=2.0)
+                    p.join(timeout=_TERMINATE_JOIN_S)
                 self._procs[i] = None
                 if self._probing[i]:
                     # The single probe attempt failed: straight back to
@@ -624,7 +630,7 @@ class ActorPool:
         p = self._procs[i]
         if p is not None and p.is_alive():
             p.terminate()
-            p.join(timeout=2.0)
+            p.join(timeout=_TERMINATE_JOIN_S)
         self._procs[i] = None
         self._probing[i] = False
         self._pending_respawn[i] = False

@@ -304,7 +304,9 @@ def run_worker(
             kind, _, dur = faults[fault_i]
             fault_i += 1
             if kind == "crash":
-                raise RuntimeError(
+                from distributed_ddpg_tpu.faults import InjectedFault
+
+                raise InjectedFault(
                     f"injected crash in worker {worker_id} at step {step}"
                 )
             if kind in ("hang", "stall"):

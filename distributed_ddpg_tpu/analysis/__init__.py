@@ -1,0 +1,42 @@
+"""Invariant lint engine: AST rules that enforce the repo's cross-cutting
+architectural contracts (docs/ANALYSIS.md).
+
+Five PRs of hard-won invariants — every DCN collective rides the audited
+deadline-wrapped entry point in parallel/multihost.py (PR 6), host<->device
+traffic goes through the transfer scheduler (PR 5), donated buffers are
+never read after dispatch without a re-bind (the PR-9 pointer re-swap bug
+class), no blocking wait carries an inline hardcoded timeout (the PR-10
+silent 600 s stall) — were enforced only by reviewer memory. TorchBeast
+(arXiv 1910.03552) and the Podracer architectures (arXiv 2104.06272) both
+locate distributed-RL correctness in exactly these cross-cutting
+discipline rules, which makes them the right target for a custom static
+pass rather than more tests: a rule fires on the NEXT violation, not the
+next outage.
+
+Pure stdlib (ast/re/json) — importing this package must never initialize
+JAX; the engine runs in CI gates and on laptops in well under 5 seconds.
+
+    python -m distributed_ddpg_tpu.tools.lint          # human output
+    scripts/lint_gate.sh                               # CI gate (exit 2)
+"""
+
+from distributed_ddpg_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    Module,
+    Rule,
+    RULES,
+    register,
+    run_lint,
+)
+from distributed_ddpg_tpu.analysis import rules as _rules  # registers RULES
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Module",
+    "Rule",
+    "RULES",
+    "register",
+    "run_lint",
+]

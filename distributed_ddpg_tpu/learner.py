@@ -58,6 +58,9 @@ METRIC_KEYS = (
 def _maybe_psum_mean(tree, axis_name: Optional[str]):
     if axis_name is None:
         return tree
+    # lint: ok(collective-discipline): only called from inside the jitted
+    # learner step — axis_name exists only when the pmap/shard_map builder
+    # (parallel/) threads it, so this traces under a mesh, never eagerly
     return jax.lax.pmean(tree, axis_name)
 
 

@@ -937,6 +937,9 @@ class ShardedLearner:
                 self._per_sample_chunk_step = self._scan_per_sample_chunk_step
                 self._sample_chunk_step = self._scan_sample_chunk_step
                 out, self._key = self._sample_chunk_step(
+                    # lint: ok(donation-safety): retry gated on `retryable`,
+                    # which verified no leaf of (state, key) is_deleted —
+                    # the failed dispatch never consumed the buffers
                     self.state, self._key, storage, size
                 )
             self._sample_chunk_compiled = True
@@ -999,6 +1002,9 @@ class ShardedLearner:
                 self._sample_chunk_step = self._scan_sample_chunk_step
                 self._per_sample_chunk_step = self._scan_per_sample_chunk_step
                 out, self._key, new_p, new_maxp = self._per_sample_chunk_step(
+                    # lint: ok(donation-safety): retry gated on `retryable`,
+                    # which verified no leaf of (state, key, priorities)
+                    # is_deleted — the failed dispatch never consumed them
                     self.state, self._key, storage, size, priorities, maxp, *args
                 )
             self._per_chunk_compiled = True

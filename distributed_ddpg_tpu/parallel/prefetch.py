@@ -23,6 +23,20 @@ import numpy as np
 from distributed_ddpg_tpu import trace
 
 
+# stop()-path drain bound: how long the worker grants an in-flight
+# transfer ticket to land after stop is requested, before abandoning it
+# to the scheduler (whose close() fails pending tickets loudly). A bound
+# on shutdown courtesy, not a liveness deadline — liveness is next()'s
+# PrefetchTimeout.
+_STOP_DRAIN_S = 5.0
+
+
+class PrefetchError(RuntimeError):
+    """The prefetch worker thread died; the original exception rides along
+    as __cause__ (the IngestError surfacing discipline). Subclasses
+    RuntimeError so pre-existing blanket handlers keep working."""
+
+
 class PrefetchTimeout(RuntimeError):
     """next() deadline expired with the worker thread still alive — replay
     starvation or a wedged device transfer, NOT a worker crash (a dead
@@ -110,7 +124,7 @@ class ChunkPrefetcher:
                     # thread died'.
                     while not ticket.done():
                         if self._stop.is_set():
-                            ticket.wait(5.0)
+                            ticket.wait(_STOP_DRAIN_S)
                             break
                         ticket.wait(0.1)
                     if not ticket.done():
@@ -138,7 +152,7 @@ class ChunkPrefetcher:
         deadline = time.monotonic() + timeout
         while True:
             if self._exc is not None:
-                raise RuntimeError("prefetch thread died") from self._exc
+                raise PrefetchError("prefetch thread died") from self._exc
             try:
                 return self._q.get(timeout=min(0.5, max(0.0, deadline - time.monotonic())))
             except queue.Empty:

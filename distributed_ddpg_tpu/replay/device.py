@@ -95,6 +95,14 @@ class IngestError(RuntimeError):
     died' surfacing discipline)."""
 
 
+class ReplayUsageError(RuntimeError):
+    """The caller used a device-replay entry point outside its supported
+    mode (per-process drain in a pod, single-writer checkpoint of a
+    sharded buffer, ...). Distinct from IngestError — nothing died; the
+    call itself is wrong, and recovery is a config/callsite change, never
+    a restart."""
+
+
 class _IngestShipper:
     """Single-process background shipper: moves staged full blocks to HBM
     off the producer's critical path, mirroring ChunkPrefetcher's
@@ -818,7 +826,7 @@ class DeviceReplay:
         executed — the barrier bench/tests use before reading storage.
         Single-process only (multi-host draining IS sync_ship)."""
         if self._procs > 1:
-            raise RuntimeError("drain_pending() is per-process; use "
+            raise ReplayUsageError("drain_pending() is per-process; use "
                                "sync_ship() in multi-host runs")
         self._check_shipper()
         moved = self._drain_ring()
@@ -832,7 +840,7 @@ class DeviceReplay:
         confined to the first/last block). Single-process only; multi-host
         callers use sync_ship(force=True)."""
         if self._procs > 1:
-            raise RuntimeError("flush() is per-process; use sync_ship() "
+            raise ReplayUsageError("flush() is per-process; use sync_ship() "
                                "in multi-host runs")
         self._check_shipper()
         self._drain_ring()
@@ -917,7 +925,7 @@ class DeviceReplay:
         FIFO grouping invariance (_coalesce_k) keeps the final storage
         bit-identical to the synchronous reference."""
         if not self._bg_sync:
-            raise RuntimeError(
+            raise ReplayUsageError(
                 "sync_ship_begin() needs background_sync=True, an attached "
                 "TransferScheduler, and a multi-process mesh"
             )
@@ -1293,7 +1301,7 @@ class DeviceReplay:
     def state_dict(self):
         with self.dispatch_lock:
             if self.sharded and self._procs > 1:
-                raise RuntimeError(
+                raise ReplayUsageError(
                     "sharded replay contents span processes and have no "
                     "single-writer checkpoint yet; train_jax omits replay "
                     "from checkpoints in multi-host sharded mode "
@@ -1317,7 +1325,7 @@ class DeviceReplay:
         if n > self.capacity:
             raise ValueError(f"checkpointed size {n} exceeds capacity {self.capacity}")
         if self.sharded and self._procs > 1:
-            raise RuntimeError(
+            raise ReplayUsageError(
                 "sharded replay contents cannot be restored multi-host "
                 "(no single-writer checkpoint; docs/REPLAY_SHARDING.md)"
             )

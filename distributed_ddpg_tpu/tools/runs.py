@@ -2,7 +2,7 @@
 
 `runs/` holds ~100 train/eval/bench files and until this module the only
 tooling was hand-diffing them (how the 8-device ingest regression in
-BENCH_r05 was found). Three subcommands over the schemas the repo already
+BENCH_r05 was found). Four subcommands over the schemas the repo already
 produces (metrics.MetricsLogger records; bench.py result JSON — both
 documented in docs/OBSERVABILITY.md):
 
@@ -18,6 +18,11 @@ documented in docs/OBSERVABILITY.md):
                                  candidate falls more than --threshold
                                  below the baseline (or above, for
                                  lower-is-better keys prefixed '-').
+  lint [findings.json]           pretty-print the invariant lint engine's
+                                 findings JSON (scripts/lint_gate.sh
+                                 artifact; docs/ANALYSIS.md) as the same
+                                 digest tables; exit 2 on unsuppressed
+                                 findings — the bench gate's contract.
 
 Pure stdlib, no numpy/jax: this must be runnable anywhere, instantly —
     python -m distributed_ddpg_tpu.tools.runs summarize runs/foo.jsonl
@@ -666,6 +671,41 @@ def gate_bench(
 # ---------------------------------------------------------------------------
 
 
+def render_lint(obj: Dict[str, Any]) -> Tuple[bool, str]:
+    """Digest tables for an invariant-lint findings JSON (the artifact
+    scripts/lint_gate.sh leaves behind; schema: analysis/engine.py
+    LintResult.to_json). Returns (clean, text) — clean mirrors the gate's
+    PASS/FAIL so CI boxes can render and re-check in one call."""
+    counts = obj.get("counts", {})
+    findings = obj.get("findings", [])
+    live = [f for f in findings if not f.get("suppressed")]
+    out = [
+        f"lint: {counts.get('files', '?')} files, "
+        f"{len(obj.get('rules', []))} rules, "
+        f"{counts.get('findings', len(live))} findings "
+        f"({counts.get('suppressed', 0)} suppressed) "
+        f"in {obj.get('elapsed_s', 0.0):.2f}s"
+    ]
+    per_rule: Dict[str, List[int]] = {}
+    for f in findings:
+        row = per_rule.setdefault(f.get("rule", "?"), [0, 0])
+        row[1 if f.get("suppressed") else 0] += 1
+    if per_rule:
+        out.append("")
+        out.append(render_table(
+            ["rule", "findings", "suppressed"],
+            [[r, n, s] for r, (n, s) in sorted(per_rule.items())],
+        ))
+    if live:
+        out.append("")
+        out.append(render_table(
+            ["location", "rule", "message"],
+            [[f"{f.get('path')}:{f.get('line')}", f.get("rule"),
+              f.get("message", "")] for f in live],
+        ))
+    return not live, "\n".join(out)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distributed_ddpg_tpu.tools.runs",
@@ -696,6 +736,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(e.g. value,-t_dispatch_ms,ingest_rows_per_sec); dotted paths "
         "descend into nested objects",
     )
+    p_lint = sub.add_parser(
+        "lint", help="pretty-print an invariant-lint findings JSON "
+        "(the scripts/lint_gate.sh artifact; exit 2 on unsuppressed "
+        "findings, same contract as the bench gate)",
+    )
+    p_lint.add_argument(
+        "path", nargs="?", default="runs/lint_findings.json",
+        help="findings JSON (default: runs/lint_findings.json, the "
+        "lint_gate.sh default artifact)",
+    )
+
     args = parser.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -735,6 +786,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(line)
         print("GATE PASS" if ok else "GATE FAIL")
         return 0 if ok else 2
+
+    if args.cmd == "lint":
+        try:
+            with open(args.path, encoding="utf-8") as fh:
+                obj = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if not isinstance(obj, dict):
+            print(f"error: {args.path} is not a findings object "
+                  "(truncated artifact?)", file=sys.stderr)
+            return 1
+        clean, text = render_lint(obj)
+        print(text)
+        print("LINT PASS" if clean else "LINT FAIL")
+        return 0 if clean else 2
 
     return 1  # unreachable (subparsers required)
 

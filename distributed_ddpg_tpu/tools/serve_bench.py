@@ -39,6 +39,13 @@ from distributed_ddpg_tpu.serve import (
 )
 
 
+# Reap bound for bench client threads after stop is set: generous next to
+# serve_fallback_s (the longest a client blocks per request), so a join
+# miss means a wedged client, not a slow one — the threads are daemons and
+# the measurement is already taken either way.
+_CLIENT_JOIN_S = 10.0
+
+
 def _random_flat(layout, seed: int) -> np.ndarray:
     rng = np.random.default_rng(seed)
     return (rng.standard_normal(layout_size(layout)) * 0.1).astype(np.float32)
@@ -102,7 +109,7 @@ def run_serve_bench(
     time.sleep(duration_s)
     stop.set()
     for t in threads:
-        t.join(timeout=10.0)
+        t.join(timeout=_CLIENT_JOIN_S)
     elapsed = time.perf_counter() - t0
     snap = server.snapshot()
     server.close()
@@ -155,7 +162,7 @@ def _measure_local_act(layout, flat, threads_n: int, duration_s: float,
     time.sleep(duration_s)
     stop.set()
     for t in threads:
-        t.join(timeout=10.0)
+        t.join(timeout=_CLIENT_JOIN_S)
     return sum(counts) / (time.perf_counter() - t0)
 
 

@@ -63,6 +63,12 @@ EXIT_POD_DEGRADED = 76
 # (pre-divergence) checkpoint rather than blindly relaunching.
 EXIT_NUMERIC = 77
 
+# Shutdown reap bound for the async eval thread: evals run whole episodes,
+# so teardown grants them real time to finish, but a wedged env must not
+# hold the trainer's exit hostage — the thread is daemonized, so past this
+# bound we abandon it and let interpreter exit reap it.
+_EVAL_JOIN_S = 60.0
+
 
 def _enable_faulthandler() -> None:
     """Stack dumps on demand (kill -USR1 <pid>) and on hard faults — a
@@ -2088,7 +2094,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         _beat()
         t = eval_thread["t"]
         if t is not None:
-            t.join(timeout=60)
+            t.join(timeout=_EVAL_JOIN_S)
         if is_multi:
             # Disarm the module-level pod deadline: a later single-process
             # train in the same interpreter must keep the zero-overhead

@@ -67,6 +67,11 @@ from distributed_ddpg_tpu import trace
 
 _EXIT_CODE = 70  # EX_SOFTWARE: internal failure, distinguishable from OOM/kill
 
+# stop() reap bound for the watchdog thread. The thread polls _stop every
+# poll tick, so this only trips when the watchdog itself is wedged mid-
+# artifact-write — and then the daemon flag reaps it at exit anyway.
+_STOP_JOIN_S = 5.0
+
 
 def _default_on_stall(timeout_s: float) -> None:
     sys.stderr.write(
@@ -142,7 +147,7 @@ class Watchdog:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=_STOP_JOIN_S)
 
     def _write_stall_artifacts(self, last_value, stalled_s: float) -> None:
         """Best-effort structured stall dump BEFORE on_stall (which, by

@@ -46,10 +46,21 @@
 #              baselines gate on value alone and the new pins arm
 #              automatically once a newer bench becomes the baseline; a
 #              key the CANDIDATE drops while the baseline has it FAILS.)
+#
+# Flags:
+#   --lint     run scripts/lint_gate.sh (the invariant lint engine,
+#              docs/ANALYSIS.md) as a pre-step before the bench-key
+#              comparison: unsuppressed findings exit 2 without touching
+#              a single bench JSON. SKIPs (exit 0) when the analysis
+#              package is absent — old baselines predate the linter.
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
-candidate="${1:?usage: ci_gate.sh <candidate.json> [baseline.json]}"
+if [ "${1:-}" = "--lint" ]; then
+    shift
+    "$repo_root/scripts/lint_gate.sh"
+fi
+candidate="${1:?usage: ci_gate.sh [--lint] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
 keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row}"
 

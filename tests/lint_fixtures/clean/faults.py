@@ -1,0 +1,3 @@
+"""Fixture: every component has its failure-matrix row."""
+
+COMPONENTS = ("worker",)

@@ -1,0 +1,8 @@
+"""Fixture: a fully documented, fully rendered *Stats family."""
+
+
+class FooStats:
+    def snapshot(self):
+        out = {"foo_thing": 1}
+        out["foo_other_thing"] = 2.0
+        return out

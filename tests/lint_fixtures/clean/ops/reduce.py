@@ -1,0 +1,6 @@
+"""Fixture: ops/ is a jit-building layer — raw collectives allowed."""
+import jax
+
+
+def psum_tree(x):
+    return jax.lax.psum(x, "data")

@@ -1,0 +1,8 @@
+"""Fixture: the one module allowed to touch the raw pod machinery."""
+import jax
+import jax.experimental.multihost_utils as multihost_utils
+
+
+def initialize():
+    jax.distributed.initialize()
+    return multihost_utils.sync_global_devices("boot")

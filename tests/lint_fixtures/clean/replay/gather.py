@@ -1,0 +1,11 @@
+"""Fixture: a collective inside a nested jit-program body is fine
+anywhere — that is the shard_map closure shape."""
+import jax
+
+
+class Ring:
+    def build(self):
+        def body(block):
+            return jax.lax.all_gather(block, "data")
+
+        return body

@@ -1,0 +1,11 @@
+"""Fixture: typed raises pass typed-error."""
+
+
+class ServeTimeout(RuntimeError):
+    pass
+
+
+def overload(pending, cap):
+    if pending > cap:
+        raise ServeTimeout("deadline expired")
+    raise ValueError("bad request")

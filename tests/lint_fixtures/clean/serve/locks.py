@@ -1,0 +1,30 @@
+"""Fixture: poll-under-lock and gather-then-lock pass lock-discipline."""
+
+
+class Dispatcher:
+    def poll(self, fut):
+        with self.dispatch_lock:
+            return fut.result(timeout=0.0)
+
+    def ordered(self, beat):
+        vals = beat_allgather([beat])
+        with self.dispatch_lock:
+            return vals
+
+    def deferred(self, ev):
+        with self.dispatch_lock:
+            # The lambda runs later, outside the lock.
+            return submit(lambda: ev.wait())
+
+    def poll_queue(self, q):
+        with self.dispatch_lock:
+            return q.get(timeout=0.0)
+
+    def poll_queue_nonblocking(self, q):
+        with self.dispatch_lock:
+            return q.get(False)
+
+    def lookup(self, table, key):
+        with self.dispatch_lock:
+            # dict.get: a key lookup, not a wait.
+            return table.get(key, 0)

@@ -1,0 +1,3 @@
+"""Fixture renderer that covers the foo_* family."""
+
+FAMILIES = ("foo_",)

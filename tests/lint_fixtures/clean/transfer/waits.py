@@ -1,0 +1,16 @@
+"""Fixture: deadlines behind named knobs pass timeout-discipline."""
+import time
+
+# Documented shutdown grace, bounded by the scheduler's close() contract.
+STOP_DRAIN_S = 5.0
+
+
+def drain(ticket, q):
+    ticket.result(timeout=STOP_DRAIN_S)
+    time.sleep(0.1)
+    q.get(timeout=0.5)
+
+
+def lookups(counts, cfg):
+    # .get's positionals are a dict key / queue block flag, not deadlines.
+    return counts.get(5), cfg.get("retries", 30)

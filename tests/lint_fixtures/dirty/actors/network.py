@@ -1,0 +1,19 @@
+"""Fixture: every shape collective-discipline flags (docs/ANALYSIS.md)."""
+import jax
+import jax.experimental.multihost_utils
+from jax.experimental import multihost_utils
+
+
+def bootstrap():
+    jax.distributed.initialize()
+    multihost_utils.sync_global_devices("ready")
+
+
+def reduce_metrics(x):
+    return jax.lax.psum(x, "data")
+
+
+def reduce_aliased(x):
+    from jax.lax import psum as psum_alias
+
+    return psum_alias(x, "data")

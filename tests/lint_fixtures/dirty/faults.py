@@ -1,0 +1,3 @@
+"""Fixture: a fault component missing from the failure matrix."""
+
+COMPONENTS = ("worker", "ghost")

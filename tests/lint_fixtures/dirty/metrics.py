@@ -1,0 +1,8 @@
+"""Fixture: a *Stats family with an undocumented field and no renderer."""
+
+
+class FooStats:
+    def snapshot(self):
+        out = {"foo_thing": 1}
+        out["foo_other_thing"] = 2.0
+        return out

@@ -1,0 +1,23 @@
+"""Fixture: the PR-9 read-after-donate bug class donation-safety flags."""
+import functools
+
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+donate = functools.partial(jax.jit, donate_argnums=(1,))
+apply_batch = donate(train_step)
+
+
+def bad_dispatch(state, batch):
+    out = step(state, batch)
+    return state
+
+
+def bad_factory(state, batch):
+    out = apply_batch(state, batch)
+    return batch

@@ -1,0 +1,7 @@
+"""Fixture: the bare raises typed-error forbids inside subsystem dirs."""
+
+
+def overload(pending, cap):
+    if pending > cap:
+        raise RuntimeError("queue full")
+    raise Exception("unreachable")

@@ -1,0 +1,21 @@
+"""Fixture: blocking waits and pod collectives under dispatch_lock."""
+
+
+class Dispatcher:
+    def bad_wait(self, fut):
+        with self.dispatch_lock:
+            return fut.result()
+
+    def bad_collective(self, beat):
+        with self.dispatch_lock:
+            return beat_allgather([beat])
+
+    def bad_after_deferred(self, ev):
+        with self.dispatch_lock:
+            cb = lambda: ev.wait()
+            submit(cb)
+            ev.wait()
+
+    def bad_queue_get(self, q):
+        with self.dispatch_lock:
+            return q.get()

@@ -1,0 +1,3 @@
+"""Fixture renderer that knows nothing about the family metrics.py emits."""
+
+FAMILIES = ("bar_",)

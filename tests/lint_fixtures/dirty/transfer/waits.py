@@ -1,0 +1,19 @@
+"""Fixture: inline literal deadlines timeout-discipline flags, next to the
+sub-second poll cadences it deliberately allows."""
+import time
+from time import sleep
+
+
+def nap():
+    sleep(600)
+
+
+def drain(ticket, q, thread):
+    ticket.result(timeout=600.0)
+    ticket.result(timeout=10 * 60)   # constant-folded spelling of 600s
+    q.get(timeout=2.0)
+    q.get(True, 600.0)    # queue.get's positional timeout form
+    thread.join(30)
+    time.sleep(5)
+    time.sleep(0.1)       # poll cadence: allowed
+    q.get(timeout=0.5)    # poll cadence: allowed

@@ -1,0 +1,6 @@
+"""Fixture: a reasonless suppression suppresses nothing and is reported."""
+import time
+
+
+def shutdown(thread):
+    time.sleep(5)  # lint: ok(timeout-discipline)

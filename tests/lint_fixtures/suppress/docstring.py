@@ -1,0 +1,7 @@
+"""Fixture: the grammar quoted in a docstring is not a suppression.
+
+    time.sleep(5)  # lint: ok(timeout-discipline): docstring example
+"""
+import time
+
+time.sleep(5)

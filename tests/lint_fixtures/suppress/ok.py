@@ -1,0 +1,11 @@
+"""Fixture: reasoned suppressions — inline and comment-only coverage."""
+import time
+
+
+def shutdown(thread):
+    time.sleep(5)  # lint: ok(timeout-discipline): fixture — documented grace
+
+
+def shutdown2(q):
+    # lint: ok(timeout-discipline): fixture — comment-only covers next stmt
+    q.get(timeout=30)

@@ -1,0 +1,3 @@
+"""Fixture: a suppression matching no finding is itself reported."""
+
+X = 1  # lint: ok(timeout-discipline): nothing here violates it
