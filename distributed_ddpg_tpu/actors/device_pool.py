@@ -430,3 +430,36 @@ class DeviceActorPool:
             out["devactor_episode_return"] = round(d_ret / d_eps, 6)
         out["devactor_restarts"] = self._restarts
         return out
+
+
+# ---------------------------------------------------------------------------
+# program-contract analyzer hook (analysis/programs.py; docs/ANALYSIS.md
+# "Layer 2")
+# ---------------------------------------------------------------------------
+
+
+def program_specs():
+    """The rollout scan as one traced program: 4 vmapped probe envs x a
+    chunk of 2, under the 2-device CPU probe mesh. The donated carry must
+    alias through in the lowered artifact — a rollout that silently stops
+    aliasing would double the env-state HBM every dispatch."""
+    from distributed_ddpg_tpu.analysis.programs import (
+        BuiltProgram,
+        ProgramSpec,
+        probe_config,
+        probe_mesh,
+    )
+
+    def build():
+        config = probe_config(device_actor_envs=4, device_actor_chunk=2)
+        pool = DeviceActorPool(config, mesh=probe_mesh())
+        from distributed_ddpg_tpu.learner import init_train_state
+
+        params = init_train_state(
+            config, pool.env.obs_dim, pool.env.act_dim, config.seed
+        ).actor_params
+        return BuiltProgram(pool._rollout, (params, pool._carry), (1,))
+
+    return [
+        ProgramSpec("devactor.rollout", "actors/device_pool.py", build),
+    ]

@@ -30,6 +30,7 @@ from distributed_ddpg_tpu.analysis.engine import (
     run_lint,
 )
 from distributed_ddpg_tpu.analysis import rules as _rules  # registers RULES
+from distributed_ddpg_tpu.analysis import progrules as _progrules  # noqa: F401 (registers recompile-hazard)
 
 __all__ = [
     "Finding",

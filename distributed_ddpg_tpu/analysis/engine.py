@@ -439,6 +439,52 @@ def run_lint(
     )
 
 
+def git_changed_files(root: Path, ref: str) -> Optional[List[str]]:
+    """Absolute paths of files changed vs `ref` — working-tree diff plus
+    untracked (new files must lint before their first commit). None when
+    git is unusable (not a repo, bad ref): callers error loudly, a gate
+    that can't see the diff must not read as green. Pure subprocess, so
+    the --changed-only fast path never imports anything heavy."""
+    import subprocess
+
+    def run(cwd: Path, *cmd: str) -> Optional[str]:
+        try:
+            proc = subprocess.run(
+                ["git", "-C", str(cwd), *cmd],
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    top = run(root, "rev-parse", "--show-toplevel")
+    if top is None:
+        return None
+    repo = Path(top.strip())
+    # Both listings must be toplevel-relative to join against `repo`, so
+    # both run AT the toplevel: `ls-files --others` always prints
+    # cwd-relative paths, and `diff --name-only` does too under
+    # `diff.relative=true` (from a `root` deeper in the repo either would
+    # silently mis-join and drop every changed file).
+    diff = run(repo, "diff", "--name-only", ref)
+    untracked = run(repo, "ls-files", "--others", "--exclude-standard")
+    if diff is None or untracked is None:
+        return None
+    names = [
+        line.strip()
+        for line in (diff + "\n" + untracked).splitlines()
+        if line.strip()
+    ]
+    seen = set()
+    out = []
+    for n in names:
+        p = str(repo / n)
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
 def render_human(result: LintResult) -> str:
     out = [f.render() for f in result.findings]
     n_bad = len(result.unsuppressed)

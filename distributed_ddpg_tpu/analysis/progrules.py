@@ -1,0 +1,268 @@
+"""Program-level static rules: the AST half of the program-contract
+analyzer (analysis/programs.py; docs/ANALYSIS.md "Layer 2").
+
+The dynamic analyzer traces the compiled programs; this module holds the
+jit-KEY hazards that are visible without tracing anything — shapes that
+make XLA recompile the same program over and over, which on a pod means
+every replica pays the multi-second compile inside the training loop
+(and on the serve path, inside a request deadline). One rule, three
+concrete shapes, all of which have shipped somewhere as "why is the TPU
+idle 40% of the time":
+
+1. a `jax.jit(...)` (or `partial(jax.jit, ...)` factory) call inside a
+   `for`/`while` body — inline, or as a decorator on a def, since a
+   decorator executes at definition time, i.e. per iteration — every
+   iteration builds a fresh callable, and the jit cache keys on the
+   function OBJECT, so each one retraces and recompiles. Worse when the
+   closure captures the loop variable: the baked-in Python scalar forces
+   one compile per distinct value.
+2. a jit built and invoked in one expression inside a function
+   (`jax.jit(fn)(x)`): the wrapper is rebuilt — and the program
+   retraced — on every call of the enclosing function.
+3. an unhashable literal (list/dict/set) passed at a static position of
+   a tracked `jax.jit(..., static_argnums=...)` callsite: dispatch
+   raises TypeError the first time that path runs — on the pod, at beat
+   cadence.
+
+Registered into the same registry as rules.py, so `tools.lint`, the
+suppression grammar, and `--rules recompile-hazard` all apply; the
+proganalyze CLI runs it alongside the traced checks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from distributed_ddpg_tpu.analysis.engine import (
+    Finding,
+    LintContext,
+    Module,
+    Rule,
+    register,
+)
+from distributed_ddpg_tpu.analysis.rules import (
+    _DonationScan,
+    _int_tuple_kwarg,
+    _jit_call,
+    dotted,
+)
+
+
+_JIT_NAMES = ("jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit")
+
+
+def _jit_like_call(node: ast.AST) -> Optional[ast.Call]:
+    """jax.jit(...) itself, or the partial(jax.jit, ...) factory shape."""
+    jc = _jit_call(node)
+    if jc is not None:
+        return jc
+    if isinstance(node, ast.Call):
+        name = dotted(node.func) or ""
+        if name in ("partial", "functools.partial") and node.args:
+            inner = dotted(node.args[0]) or ""
+            if inner in ("jit", "jax.jit"):
+                return node
+    return None
+
+
+def _static_positions(call: ast.Call) -> Tuple[int, ...]:
+    """Literal static_argnums of a jit call, () when absent/computed."""
+    return _int_tuple_kwarg(call, "static_argnums") or ()
+
+
+class _StaticJitScan:
+    """Names bound to jax.jit(..., static_argnums=...) results — the
+    static-position twin of rules._DonationScan, kept deliberately
+    narrow the same way (plain/annotated assigns, no alias chasing;
+    the binding shapes come from _DonationScan._binding)."""
+
+    def __init__(self, tree: ast.Module):
+        self.static: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            bind = _DonationScan._binding(node)
+            if bind is None:
+                continue
+            targets, value = bind
+            jc = _jit_call(value)
+            if jc is None:
+                continue
+            pos = _static_positions(jc)
+            if pos:
+                for t in targets:
+                    tn = dotted(t)
+                    if tn:
+                        self.static[tn] = pos
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+def _walk_skipping_deferred(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """ast.walk minus the bodies of nested def/lambda: a def or lambda
+    inside a loop DEFERS execution, so a jit call in its body runs when
+    the helper is called (possibly once — the ProgramSpec-builder
+    idiom), not per iteration. Decorators and class bodies still
+    descend: both execute at definition time, i.e. per iteration —
+    `@jax.jit` on a def in a loop body builds a fresh callable every
+    pass exactly like an inline jit call."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class RecompileHazard(Rule):
+    """Jit-key hazards: shapes that silently turn one compile into a
+    compile-per-call (module docstring). The finding always names the
+    hazard AND the sanctioned idiom — hoist the jit, cache per shape
+    (replay/device.py's `_get_insert` dict), or make the static arg
+    hashable."""
+
+    name = "recompile-hazard"
+    doc = (
+        "no jax.jit inside a loop body, no jit-and-call in one "
+        "expression inside a function, no unhashable literal at a "
+        "static_argnums position"
+    )
+
+    def check_module(self, module: Module, ctx: LintContext) -> Iterable[Finding]:
+        if module.tree is None:
+            return
+        statics = _StaticJitScan(module.tree).static
+
+        def findings():
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.For, ast.While)):
+                    yield from self._scan_loop(module, node)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._scan_inline_jit(module, node)
+                if isinstance(node, ast.Call):
+                    yield from self._check_static_args(module, node, statics)
+
+        # ast.walk visits nested loops/defs once per ancestor scan — the
+        # same hazard must report once. Messages can differ across scans
+        # (only the innermost loop's scan sees its loop variable in the
+        # closure), so dedup on position and keep the richest message.
+        best: Dict[Tuple[int, int], Finding] = {}
+        order: List[Tuple[int, int]] = []
+        for f in findings():
+            key = (f.line, f.col)
+            cur = best.get(key)
+            if cur is None:
+                order.append(key)
+                best[key] = f
+            elif len(f.message) > len(cur.message):
+                best[key] = f
+        for key in order:
+            yield best[key]
+
+    # -- shape 1: jit built inside a loop body -------------------------
+
+    def _scan_loop(self, module: Module, loop) -> Iterable[Finding]:
+        loop_vars: Set[str] = set()
+        if isinstance(loop, ast.For):
+            for n in ast.walk(loop.target):
+                if isinstance(n, ast.Name):
+                    loop_vars.add(n.id)
+        for stmt in loop.body + loop.orelse:
+            for node in _walk_skipping_deferred(stmt):
+                # A BARE `@jax.jit` decorator on a def in the loop body is
+                # the same hazard with no Call node to match: the decorator
+                # executes at definition time, i.e. per iteration. (Call-
+                # shaped decorators — `@jax.jit(...)`, `@partial(jax.jit,
+                # ...)` — flow through the walk and match below.)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if (not isinstance(dec, ast.Call)
+                                and (dotted(dec) or "") in _JIT_NAMES):
+                            yield module.finding(
+                                self.name, dec,
+                                f"@{dotted(dec)} on a def inside a loop "
+                                "body — the decorator runs at definition "
+                                "time, so each iteration builds a fresh "
+                                "jitted callable that retraces and "
+                                "recompiles; hoist the jitted helper out "
+                                "of the loop",
+                            )
+                    continue
+                jc = _jit_like_call(node)
+                if jc is None or not isinstance(node, ast.Call):
+                    continue
+                captured = self._captured_loop_var(jc, loop_vars)
+                extra = (
+                    f" — and the jitted closure captures loop variable "
+                    f"`{captured}` as a baked-in Python scalar, one "
+                    "recompile per distinct value"
+                    if captured else ""
+                )
+                yield module.finding(
+                    self.name, node,
+                    "jax.jit() inside a loop body — each iteration builds "
+                    "a fresh callable and the jit cache keys on the "
+                    "function object, so the same program retraces and "
+                    "recompiles every pass; hoist the jit out of the loop "
+                    "or cache per static shape (the replay _get_insert "
+                    f"dict idiom){extra}",
+                )
+
+    @staticmethod
+    def _captured_loop_var(jc: ast.Call, loop_vars: Set[str]) -> Optional[str]:
+        if not loop_vars or not jc.args:
+            return None
+        target = jc.args[0]
+        if isinstance(target, ast.Lambda):
+            for n in ast.walk(target.body):
+                if isinstance(n, ast.Name) and n.id in loop_vars:
+                    return n.id
+        return None
+
+    # -- shape 2: jit-and-invoke in one expression ---------------------
+
+    def _scan_inline_jit(self, module: Module, fn) -> Iterable[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # Only a DIRECT jax.jit(...) call invoked in place counts:
+            # `partial(jax.jit, ...)(fn)` merely builds the wrapper (the
+            # sanctioned bind-once factory idiom) — no program is traced
+            # by the outer call.
+            jc = _jit_call(node.func)
+            if jc is not None and isinstance(node.func, ast.Call):
+                yield module.finding(
+                    self.name, node,
+                    "jit built and invoked in one expression "
+                    "(`jax.jit(fn)(...)`) inside a function — the wrapper "
+                    "is rebuilt and the program retraced on every call of "
+                    "the enclosing function; bind the jitted callable "
+                    "once (module level or __init__) and dispatch through "
+                    "the binding",
+                )
+
+    # -- shape 3: unhashable literal at a static position --------------
+
+    def _check_static_args(self, module: Module, call: ast.Call,
+                           statics: Dict[str, Tuple[int, ...]]
+                           ) -> Iterable[Finding]:
+        callee = dotted(call.func)
+        pos = statics.get(callee or "")
+        if not pos:
+            return
+        for i in pos:
+            if i < len(call.args) and isinstance(call.args[i], _UNHASHABLE):
+                kind = type(call.args[i]).__name__.lower().replace("comp", " comprehension")
+                yield module.finding(
+                    self.name, call.args[i],
+                    f"{kind} literal passed at static position {i} of "
+                    f"{callee}() — static jit args must be hashable "
+                    "(dispatch raises TypeError the first time this path "
+                    "runs); pass a tuple / frozen value instead",
+                )

@@ -317,9 +317,13 @@ class TimeoutDiscipline(Rule):
 # ---------------------------------------------------------------------------
 
 
-def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
-    """The literal donate_argnums of a jax.jit(...) call, or None."""
-    kw = keyword_arg(call, "donate_argnums")
+def _int_tuple_kwarg(call: ast.Call, name: str) -> Optional[Tuple[int, ...]]:
+    """Literal int-tuple value of keyword `name` on `call` (scalar, tuple,
+    or list literal of ints — donate_argnums/static_argnums shapes), None
+    when absent or computed. Shared by the donation-safety rule and
+    progrules' recompile-hazard so literal-parsing hardening (constant
+    folding etc.) lands in one place."""
+    kw = keyword_arg(call, name)
     if kw is None:
         return None
     if isinstance(kw, (ast.Tuple, ast.List)):
@@ -334,6 +338,11 @@ def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
     return (int(v),) if v is not None and int(v) == v else None
 
 
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The literal donate_argnums of a jax.jit(...) call, or None."""
+    return _int_tuple_kwarg(call, "donate_argnums")
+
+
 def _jit_call(node: ast.AST) -> Optional[ast.Call]:
     if isinstance(node, ast.Call):
         name = dotted(node.func) or ""
@@ -342,11 +351,35 @@ def _jit_call(node: ast.AST) -> Optional[ast.Call]:
     return None
 
 
+def _own_statements(fn: ast.AST) -> Iterable[ast.stmt]:
+    """Every statement in `fn`'s own body, NOT descending into nested
+    function/class definitions — a nested helper's `return jax.jit(...)`
+    belongs to the helper, not to the enclosing method."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        stmt = stack.pop()
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for field in stmt._fields:
+            val = getattr(stmt, field, None)
+            if not isinstance(val, list):
+                continue
+            stack.extend(v for v in val if isinstance(v, ast.stmt))
+            for v in val:  # except-handlers wrap their own stmt lists
+                if isinstance(v, ast.excepthandler):
+                    stack.extend(v.body)
+
+
 class _DonationScan:
     """Per-module registry of 'known donated callsites': names (locals and
     self-attributes) bound — via plain or annotated assignment — to
     jax.jit(..., donate_argnums=...) results, including the
-    `donate = partial(jax.jit, donate_argnums=...)` factory idiom. Values
+    `donate = partial(jax.jit, donate_argnums=...)` factory idiom AND the
+    local-def factory idiom (`def _jit_chunk(fn): return jax.jit(fn,
+    donate_argnums=(0, 1, 4))` — the parallel/learner.py shape whose
+    multi-arg donation tuples must be tracked through the helper). Values
     map callee -> donated positional indices. Aliases of a tracked name
     (`self.f = self.g`) are NOT chased — deliberately narrow, like every
     rule here."""
@@ -366,6 +399,19 @@ class _DonationScan:
         # Two passes so a factory defined after first use still resolves
         # (order in a class body is not execution order).
         for node in ast.walk(tree):
+            # Local-def factory: a helper whose own `return` hands back a
+            # jax.jit(..., donate_argnums=...) — `_jit_per_chunk` in
+            # parallel/learner.py. Calling it binds the target to the
+            # FULL donated tuple (e.g. (0, 1, 4, 9)), so a later read of
+            # ANY donated position is flagged, not just arg 0.
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for stmt in _own_statements(node):
+                    if isinstance(stmt, ast.Return) and stmt.value is not None:
+                        jc = _jit_call(stmt.value)
+                        pos = _donated_positions(jc) if jc is not None else None
+                        if pos:
+                            factories[node.name] = pos
+                continue
             bind = self._binding(node)
             if bind is None:
                 continue

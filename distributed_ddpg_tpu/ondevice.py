@@ -350,3 +350,29 @@ class OnDeviceDDPG:
                 jnp.asarray(n, jnp.int32), self._carry_sharding.size
             ),
         )
+
+
+# ---------------------------------------------------------------------------
+# program-contract analyzer hook (analysis/programs.py; docs/ANALYSIS.md
+# "Layer 2")
+# ---------------------------------------------------------------------------
+
+
+def program_specs():
+    """The fused env+replay+learner megastep as one traced program. The
+    whole carry — train state, env states, the HBM ring — is donated; any
+    leaf that stops aliasing doubles the RING in HBM, which is the
+    costliest donation miss in the repo."""
+    from distributed_ddpg_tpu.analysis.programs import (
+        BuiltProgram,
+        ProgramSpec,
+        probe_config,
+        probe_mesh,
+    )
+
+    def build():
+        config = probe_config(num_actors=4, warmup_uniform_steps=8)
+        od = OnDeviceDDPG(config, mesh=probe_mesh(), chunk_size=2)
+        return BuiltProgram(od._chunk, (od.carry,), (0,))
+
+    return [ProgramSpec("ondevice.chunk", "ondevice.py", build)]

@@ -1128,3 +1128,137 @@ class ShardedLearner:
             return
         self._lr_scale = scale
         self._build_programs()
+
+
+# ---------------------------------------------------------------------------
+# program-contract analyzer hook (analysis/programs.py; docs/ANALYSIS.md
+# "Layer 2")
+# ---------------------------------------------------------------------------
+
+
+def program_specs():
+    """Every hot learner chunk program, built tiny (8-wide batch, 16-wide
+    hiddens, chunk of 2) under the 2-device CPU probe mesh. jit is lazy,
+    so each build costs one trace and zero compiles. The guarded and
+    unguarded variants of each chunk shape dispatch at the SAME lockstep
+    site (train.py picks per config), so they share a beat_group: their
+    explicitly-staged collective order must be identical or a pod mixing
+    configs would fork."""
+    from distributed_ddpg_tpu.analysis.programs import (
+        BuiltProgram,
+        ProgramSpec,
+        probe_config,
+        probe_mesh,
+    )
+
+    OWNER = "parallel/learner.py"
+    cache: Dict[tuple, ShardedLearner] = {}
+
+    def learner(guard: bool = False, sharded: bool = False) -> ShardedLearner:
+        key = (guard, sharded)
+        if key not in cache:
+            cache[key] = ShardedLearner(
+                probe_config(guardrails=guard),
+                obs_dim=3,
+                act_dim=1,
+                action_scale=np.ones(1, np.float32),
+                mesh=probe_mesh(),
+                chunk_size=2,
+                replay_sharding="sharded" if sharded else "replicated",
+            )
+        return cache[key]
+
+    def storage_for(L: ShardedLearner):
+        width = 2 * L.obs_dim + L.act_dim + 3  # the packed replay row
+        spec = P("data", None) if L._replay_sharded else P(None, None)
+        storage = jax.device_put(
+            np.zeros((64, width), np.float32), NamedSharding(L.mesh, spec)
+        )
+        return storage, np.int32(64)
+
+    def hostfed(guard: bool):
+        def build():
+            L = learner(guard=guard)
+            width = 2 * L.obs_dim + L.act_dim + 3
+            chunk = jax.device_put(
+                np.zeros((L.chunk_size, L.global_batch, width), np.float32),
+                L._chunk_sharding,
+            )
+            if guard:
+                return BuiltProgram(
+                    L._chunk_step, (L.state, chunk, L._guard), (0, 2)
+                )
+            return BuiltProgram(L._chunk_step, (L.state, chunk), (0,))
+        return build
+
+    def uniform(guard: bool, sharded: bool):
+        def build():
+            L = learner(guard=guard, sharded=sharded)
+            storage, size = storage_for(L)
+            if guard:
+                return BuiltProgram(
+                    L._sample_chunk_step,
+                    (L.state, L._key, storage, size, L._guard),
+                    (0, 1, 4),
+                )
+            return BuiltProgram(
+                L._sample_chunk_step, (L.state, L._key, storage, size),
+                (0, 1),
+            )
+        return build
+
+    def per(guard: bool, sharded: bool):
+        def build():
+            L = learner(guard=guard, sharded=sharded)
+            storage, size = storage_for(L)
+            prios = jax.device_put(
+                np.zeros(64, np.float32),
+                NamedSharding(
+                    L.mesh, P("data") if L._replay_sharded else P(None)
+                ),
+            )
+            scalars = (np.float32(1.0), np.float32(0.4), np.float32(0.6),
+                       np.float32(1e-6))
+            if guard:
+                return BuiltProgram(
+                    L._per_sample_chunk_step,
+                    (L.state, L._key, storage, size, prios, *scalars,
+                     L._guard),
+                    (0, 1, 4, 9),
+                )
+            return BuiltProgram(
+                L._per_sample_chunk_step,
+                (L.state, L._key, storage, size, prios, *scalars),
+                (0, 1, 4),
+            )
+        return build
+
+    specs = []
+    for guard in (False, True):
+        tag = ".guarded" if guard else ""
+        specs.extend([
+            ProgramSpec(
+                f"learner.chunk.hostfed{tag}", OWNER, hostfed(guard),
+                beat_group="learner-beat-hostfed",
+            ),
+            ProgramSpec(
+                f"learner.chunk.uniform{tag}", OWNER,
+                uniform(guard, sharded=False),
+                beat_group="learner-beat-uniform",
+            ),
+            ProgramSpec(
+                f"learner.chunk.per{tag}", OWNER, per(guard, sharded=False),
+                beat_group="learner-beat-per",
+            ),
+            ProgramSpec(
+                f"learner.chunk.uniform.sharded{tag}", OWNER,
+                uniform(guard, sharded=True),
+                beat_group="learner-beat-uniform-sharded",
+            ),
+            ProgramSpec(
+                f"learner.chunk.per.sharded{tag}", OWNER,
+                per(guard, sharded=True),
+                beat_group="learner-beat-per-sharded",
+            ),
+        ])
+    return specs

@@ -1650,3 +1650,81 @@ class DevicePrioritizedReplay(DeviceReplay):
                 if vec_sharding is not None:
                     self.priorities = jax.device_put(self.priorities, vec_sharding)
                     self.max_priority = jax.device_put(self.max_priority, scalar)
+
+
+# ---------------------------------------------------------------------------
+# program-contract analyzer hook (analysis/programs.py; docs/ANALYSIS.md
+# "Layer 2")
+# ---------------------------------------------------------------------------
+
+
+def program_specs():
+    """The donated insert/scatter/stamp program family, built over tiny
+    rings (capacity 64, blocks of 8) — replicated and sharded placement
+    both. The multi-host global inserts (all-gather beats) need a real
+    multi-process pod and are exercised by the gloo chaos tests instead;
+    this registry holds what one process can trace."""
+    from distributed_ddpg_tpu.analysis.programs import (
+        BuiltProgram,
+        ProgramSpec,
+        probe_mesh,
+    )
+
+    OWNER = "replay/device.py"
+    M = 8  # rows per probe ship (one block)
+
+    def insert():
+        r = DeviceReplay(64, 3, 1, block_size=M, async_ship=False)
+        block = np.zeros((M, r.width), np.float32)
+        return BuiltProgram(r._insert, (r.storage, block, r.ptr, r.size), (0,))
+
+    def insert_sharded():
+        r = DeviceReplay(
+            64, 3, 1, mesh=probe_mesh(), block_size=M, async_ship=False,
+            replay_sharding="sharded",
+        )
+        block = jax.device_put(
+            np.zeros((M, r.width), np.float32), r._block_sharding_sharded
+        )
+        return BuiltProgram(
+            r._get_insert_grouped(M), (r.storage, block, r.ptr, r.size), (0,)
+        )
+
+    def insert_devrows_sharded():
+        mesh = probe_mesh()
+        r = DeviceReplay(
+            64, 3, 1, mesh=mesh, block_size=M, async_ship=False,
+            replay_sharding="sharded",
+        )
+        rows = jax.device_put(
+            np.zeros((M, r.width), np.float32),
+            NamedSharding(mesh, P(None, None)),
+        )
+        return BuiltProgram(
+            r._get_insert_replrows(M), (r.storage, rows, r.ptr, r.size), (0,)
+        )
+
+    def stamp():
+        r = DevicePrioritizedReplay(64, 3, 1, block_size=M, async_ship=False)
+        return BuiltProgram(
+            r._get_stamp(M), (r.priorities, r.max_priority, r.ptr), (0,)
+        )
+
+    def stamp_sharded():
+        r = DevicePrioritizedReplay(
+            64, 3, 1, mesh=probe_mesh(), block_size=M, async_ship=False,
+            replay_sharding="sharded",
+        )
+        return BuiltProgram(
+            r._get_stamp(M), (r.priorities, r.max_priority, r.ptr), (0,)
+        )
+
+    return [
+        ProgramSpec("replay.insert", OWNER, insert),
+        ProgramSpec("replay.insert.sharded", OWNER, insert_sharded),
+        ProgramSpec(
+            "replay.insert.devrows.sharded", OWNER, insert_devrows_sharded
+        ),
+        ProgramSpec("replay.stamp", OWNER, stamp),
+        ProgramSpec("replay.stamp.sharded", OWNER, stamp_sharded),
+    ]

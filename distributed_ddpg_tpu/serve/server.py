@@ -218,3 +218,34 @@ class InferenceServer:
         """The serve_* family (metrics.ServeStats) with the live queue
         depth riding in as a gauge."""
         return self.stats.snapshot(queue_depth=self.batcher.depth())
+
+
+# ---------------------------------------------------------------------------
+# program-contract analyzer hook (analysis/programs.py; docs/ANALYSIS.md
+# "Layer 2")
+# ---------------------------------------------------------------------------
+
+
+def program_specs():
+    """The jax-backend serve apply: one fixed-shape jitted mu(s) over the
+    padded (max_batch, obs_dim) batch. No donation (params are shared
+    across dispatches); the checks that matter here are the callback leak
+    (a debug print in the serve path would ride inside every request
+    deadline) and the empty collective fingerprint (serving must never
+    stage a collective — it runs outside the pod's lockstep beats)."""
+    from distributed_ddpg_tpu.analysis.programs import (
+        BuiltProgram,
+        ProgramSpec,
+    )
+
+    def build():
+        from distributed_ddpg_tpu.actors.policy import param_layout
+
+        layout = param_layout(3, 1, (16, 16))
+        server = InferenceServer(
+            layout, np.ones(1, np.float32), backend="jax", max_batch=8
+        )
+        obs = np.zeros((8, 3), np.float32)
+        return BuiltProgram(server._jax_apply, (server._jax_params, obs))
+
+    return [ProgramSpec("serve.apply.jax", "serve/server.py", build)]

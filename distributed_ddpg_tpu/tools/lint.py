@@ -61,6 +61,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="print the rule catalog and exit",
     )
     parser.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="lint only files changed vs the git ref (default HEAD) — "
+             "the sub-second pre-commit mode; note the cross-file doc "
+             "rules see only the changed subset (docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
         "--quiet", action="store_true",
         help="suppress per-finding lines (summary + exit code only)",
     )
@@ -112,6 +119,48 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "--root (rule path-scoping is root-relative)",
                   file=sys.stderr)
             return 1
+
+    if args.changed_only is not None:
+        from distributed_ddpg_tpu.analysis.engine import (
+            _is_test_file,
+            git_changed_files,
+        )
+
+        changed = git_changed_files(root, args.changed_only)
+        if changed is None:
+            print(
+                f"error: --changed-only needs a git checkout and a valid "
+                f"ref (git diff --name-only {args.changed_only} failed)",
+                file=sys.stderr,
+            )
+            return 1
+        rootr = root.resolve()
+        # Explicit path args compose as a FILTER within the changed set
+        # (same semantics as proganalyze --programs + --changed-only): a
+        # pre-commit hook scoped to one subsystem must not fail on
+        # unrelated changed files elsewhere in the tree.
+        explicit = [p.resolve() for p in args.paths] if args.paths else None
+        selected = []
+        for c in changed:
+            p = Path(c)
+            if p.suffix != ".py" or not p.is_file():
+                continue
+            r = p.resolve()
+            if not r.is_relative_to(rootr) or _is_test_file(rootr, r):
+                continue
+            if explicit is not None and not any(
+                    r == e or r.is_relative_to(e) for e in explicit):
+                continue
+            selected.append(p)
+        if not selected:
+            scope = root if explicit is None else ", ".join(
+                str(p) for p in args.paths)
+            print(
+                f"lint: no changed non-test Python files under {scope} vs "
+                f"{args.changed_only} — nothing to lint"
+            )
+            return 0
+        paths = selected
 
     docs = args.docs
     if docs is None:

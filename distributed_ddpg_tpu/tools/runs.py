@@ -706,6 +706,39 @@ def render_lint(obj: Dict[str, Any]) -> Tuple[bool, str]:
     return not live, "\n".join(out)
 
 
+def render_programs(obj: Dict[str, Any]) -> Tuple[bool, str]:
+    """Digest tables for a program-contract analyzer report JSON (the
+    artifact scripts/proganalyze_gate.sh leaves behind; schema:
+    analysis/programs.py ProgramReport.to_json). Returns (clean, text) —
+    clean mirrors the gate's PASS/FAIL."""
+    counts = obj.get("counts", {})
+    findings = obj.get("findings", [])
+    programs = obj.get("programs", [])
+    out = [
+        f"programs: {counts.get('programs', len(programs))} traced, "
+        f"{counts.get('findings', len(findings))} findings "
+        f"in {obj.get('elapsed_s', 0.0):.2f}s"
+    ]
+    if obj.get("updated"):
+        out.append(f"updated goldens: {', '.join(obj['updated'])}")
+    if programs:
+        out.append("")
+        out.append(render_table(
+            ["program", "collectives", "fingerprint", "donated", "aliased"],
+            [[p.get("name"), len(p.get("collectives", [])),
+              p.get("fingerprint", "?"), p.get("donated_leaves", 0),
+              p.get("aliased_leaves", 0)] for p in programs],
+        ))
+    if findings:
+        out.append("")
+        out.append(render_table(
+            ["program", "check", "message"],
+            [[f.get("program"), f.get("check"), f.get("message", "")]
+             for f in findings],
+        ))
+    return not findings, "\n".join(out)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distributed_ddpg_tpu.tools.runs",
@@ -745,6 +778,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "path", nargs="?", default="runs/lint_findings.json",
         help="findings JSON (default: runs/lint_findings.json, the "
         "lint_gate.sh default artifact)",
+    )
+    p_prog = sub.add_parser(
+        "programs", help="pretty-print a program-contract analyzer report "
+        "JSON (the scripts/proganalyze_gate.sh artifact; exit 2 on "
+        "findings, same contract as the lint digest)",
+    )
+    p_prog.add_argument(
+        "path", nargs="?", default="runs/program_findings.json",
+        help="report JSON (default: runs/program_findings.json, the "
+        "proganalyze_gate.sh default artifact)",
     )
 
     args = parser.parse_args(argv)
@@ -801,6 +844,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         clean, text = render_lint(obj)
         print(text)
         print("LINT PASS" if clean else "LINT FAIL")
+        return 0 if clean else 2
+
+    if args.cmd == "programs":
+        try:
+            with open(args.path, encoding="utf-8") as fh:
+                obj = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if not isinstance(obj, dict):
+            print(f"error: {args.path} is not a program report object "
+                  "(truncated artifact?)", file=sys.stderr)
+            return 1
+        clean, text = render_programs(obj)
+        print(text)
+        print("PROGRAMS PASS" if clean else "PROGRAMS FAIL")
         return 0 if clean else 2
 
     return 1  # unreachable (subparsers required)

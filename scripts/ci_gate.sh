@@ -53,14 +53,23 @@
 #              comparison: unsuppressed findings exit 2 without touching
 #              a single bench JSON. SKIPs (exit 0) when the analysis
 #              package is absent — old baselines predate the linter.
+#   --programs run scripts/proganalyze_gate.sh (the Layer-2 program-
+#              contract analyzer, docs/ANALYSIS.md) as a pre-step:
+#              donation-aliasing / collective-order / host-callback
+#              findings exit 2 before any bench JSON is read. Same SKIP
+#              semantics when analysis/programs.py is absent. Both flags
+#              compose: `ci_gate.sh --lint --programs cand.json`.
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
-if [ "${1:-}" = "--lint" ]; then
-    shift
-    "$repo_root/scripts/lint_gate.sh"
-fi
-candidate="${1:?usage: ci_gate.sh [--lint] <candidate.json> [baseline.json]}"
+while :; do
+    case "${1:-}" in
+        --lint) "$repo_root/scripts/lint_gate.sh"; shift ;;
+        --programs) "$repo_root/scripts/proganalyze_gate.sh"; shift ;;
+        *) break ;;
+    esac
+done
+candidate="${1:?usage: ci_gate.sh [--lint] [--programs] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
 keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row}"
 

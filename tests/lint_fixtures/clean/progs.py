@@ -1,0 +1,37 @@
+"""Fixture: sanctioned jit patterns pass recompile-hazard — hoisted
+wrappers, the per-shape dict cache (replay/device.py _get_insert idiom),
+and hashable static args."""
+import jax
+
+
+step = jax.jit(lambda s, n: s * n, static_argnums=(1,))
+apply_fn = jax.jit(lambda v: v + 1)
+
+
+@jax.jit
+def decorated_apply(v):
+    # A decorated def OUTSIDE any loop builds its wrapper once — clean.
+    return v - 1
+
+
+class ShapeCache:
+    def __init__(self):
+        self._cache = {}
+
+    def program(self, m):
+        fn = self._cache.get(m)
+        if fn is None:
+            fn = jax.jit(lambda x: x.reshape(m, -1))
+            self._cache[m] = fn
+        return fn
+
+
+def good_loop(xs):
+    outs = []
+    for _ in range(4):
+        outs.append(apply_fn(xs))
+    return outs
+
+
+def good_static_tuple(x):
+    return step(x, (1, 2))

@@ -12,3 +12,15 @@ step = jax.jit(train_step, donate_argnums=(0,))
 def good_dispatch(state, batch):
     state = step(state, batch)
     return state
+
+
+def _jit_chunk(fn):
+    return jax.jit(fn, donate_argnums=(0, 1, 4))
+
+
+chunk_step = _jit_chunk(train_step)
+
+
+def good_multi_arg(state, key, storage, size, priorities):
+    out, key, priorities = chunk_step(state, key, storage, size, priorities)
+    return out, key, priorities

@@ -1,0 +1,31 @@
+"""Fixture: the jit-key hazard shapes recompile-hazard flags."""
+import jax
+
+
+step = jax.jit(lambda s, n: s * n, static_argnums=(1,))
+
+
+def bad_loop_jit(xs):
+    outs = []
+    for k in range(4):
+        f = jax.jit(lambda x: x * k)
+        outs.append(f(xs))
+    return outs
+
+
+def bad_decorated_loop_jit(xs):
+    outs = []
+    for k in range(4):
+        @jax.jit
+        def g(x):
+            return x * k
+        outs.append(g(xs))
+    return outs
+
+
+def bad_inline_jit(x):
+    return jax.jit(lambda v: v + 1)(x)
+
+
+def bad_static_list(x):
+    return step(x, [1, 2])

@@ -21,3 +21,17 @@ def bad_dispatch(state, batch):
 def bad_factory(state, batch):
     out = apply_batch(state, batch)
     return batch
+
+
+def _jit_chunk(fn):
+    """The parallel/learner.py local-def factory idiom: the helper's
+    return carries the multi-arg donate tuple."""
+    return jax.jit(fn, donate_argnums=(0, 1, 4))
+
+
+chunk_step = _jit_chunk(train_step)
+
+
+def bad_multi_arg(state, key, storage, size, priorities):
+    out = chunk_step(state, key, storage, size, priorities)
+    return priorities

@@ -39,6 +39,7 @@ EXPECTED_RULES = {
     "typed-error",
     "lock-discipline",
     "observability-drift",
+    "recompile-hazard",
 }
 
 
@@ -73,13 +74,14 @@ def test_dirty_tree_fires_every_rule_with_expected_counts():
     assert counts == {
         "collective-discipline": 6,
         "timeout-discipline": 7,
-        "donation-safety": 2,
+        "donation-safety": 3,
         "typed-error": 2,
         "lock-discipline": 4,
         "observability-drift": 3,
+        "recompile-hazard": 4,
     }
     # Nothing in the dirty tree is suppressed — every finding gates.
-    assert len(result.unsuppressed) == len(result.findings) == 24
+    assert len(result.unsuppressed) == len(result.findings) == 29
 
 
 def test_dirty_tree_known_bad_locations():
@@ -90,6 +92,15 @@ def test_dirty_tree_known_bad_locations():
     msgs = [f.message for f in by_rule["donation-safety"]]
     assert any("`state`" in m and "step()" in m for m in msgs)
     assert any("`batch`" in m and "apply_batch()" in m for m in msgs)
+    # The local-def factory idiom tracks the FULL multi-arg donate tuple:
+    # reading position 4 (not just arg 0) after dispatch is flagged.
+    assert any("`priorities`" in m and "chunk_step()" in m for m in msgs)
+    # recompile-hazard covers all four jit-key hazard shapes.
+    prog_msgs = [f.message for f in by_rule["recompile-hazard"]]
+    assert any("loop body" in m and "`k`" in m for m in prog_msgs)
+    assert any("@jax.jit on a def inside a loop body" in m for m in prog_msgs)
+    assert any("one expression" in m for m in prog_msgs)
+    assert any("static position 1" in m for m in prog_msgs)
     # timeout-discipline reports the literal it saw.
     assert any("600s" in f.message for f in by_rule["timeout-discipline"])
     # observability-drift covers both metric drift and fault-grammar drift.
@@ -380,7 +391,7 @@ def test_json_schema(tmp_path):
     obj = json.loads(out.read_text())
     assert obj["version"] == 1
     assert set(obj["counts"]) == {"files", "findings", "suppressed"}
-    assert obj["counts"]["findings"] == 24
+    assert obj["counts"]["findings"] == 29
     assert obj["counts"]["suppressed"] == 0
     assert sorted(obj["rules"]) == sorted(r.name for r in RULES)
     assert isinstance(obj["elapsed_s"], float)
@@ -594,3 +605,181 @@ def test_ci_gate_lint_prestep_runs_before_usage_check():
     )
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
     assert "files," in proc.stdout  # the lint summary line ran first
+
+
+# ---------------------------------------------------------------------------
+# --changed-only (the sub-second pre-commit mode; docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.name=t", "-c",
+         "user.email=t@t", *args],
+        check=True, capture_output=True, timeout=30,
+    )
+
+
+@pytest.fixture()
+def lint_repo(tmp_path):
+    """A tiny git repo: one clean file, one file carrying the 4 known
+    recompile-hazard findings — both committed, so HEAD is the baseline."""
+    repo = (tmp_path / "repo").resolve()
+    (repo / "replay").mkdir(parents=True)
+    (repo / "replay" / "donate.py").write_text(
+        (FIX / "clean" / "replay" / "donate.py").read_text(),
+        encoding="utf-8",
+    )
+    (repo / "progs.py").write_text(
+        (FIX / "dirty" / "progs.py").read_text(), encoding="utf-8"
+    )
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "seed")
+    return repo
+
+
+def test_changed_only_nothing_changed(lint_repo, capsys):
+    rc = lint_cli.main(["--changed-only", "HEAD", "--root", str(lint_repo)])
+    assert rc == 0
+    assert "nothing to lint" in capsys.readouterr().out
+
+
+def test_changed_only_scopes_to_the_diff(lint_repo, capsys):
+    # progs.py carries 3 recompile-hazard findings, but only the CLEAN
+    # file changed: the scoped run must not see them.
+    donate = lint_repo / "replay" / "donate.py"
+    donate.write_text(donate.read_text() + "\n# touched\n",
+                      encoding="utf-8")
+    rc = lint_cli.main(["--changed-only", "HEAD", "--root", str(lint_repo)])
+    assert rc == 0
+    capsys.readouterr()
+    # Once the dirty file changes too, its findings gate the scoped run.
+    progs = lint_repo / "progs.py"
+    progs.write_text(progs.read_text() + "\n# touched\n", encoding="utf-8")
+    rc = lint_cli.main(["--changed-only", "HEAD", "--root", str(lint_repo)])
+    assert rc == 2
+    assert "recompile-hazard" in capsys.readouterr().out
+
+
+def test_changed_only_sees_untracked_files(lint_repo):
+    # A new file must lint BEFORE its first commit.
+    (lint_repo / "replay" / "fresh.py").write_text(
+        (FIX / "dirty" / "progs.py").read_text(), encoding="utf-8"
+    )
+    rc = lint_cli.main(["--changed-only", "HEAD", "--root", str(lint_repo)])
+    assert rc == 2
+
+
+def test_changed_only_bad_ref_errors(lint_repo, capsys):
+    rc = lint_cli.main(
+        ["--changed-only", "no-such-ref", "--root", str(lint_repo)]
+    )
+    assert rc == 1
+    assert "--changed-only" in capsys.readouterr().err
+
+
+def test_recompile_hazard_nested_loop_reports_once(tmp_path):
+    # ast.walk scans the inner loop once per ancestor loop; the hazard
+    # must still report once, keeping the richer (captured-loop-var)
+    # message.
+    (tmp_path / "nested.py").write_text(
+        "import jax\n\n\n"
+        "def f(xs):\n"
+        "    for i in range(2):\n"
+        "        for k in range(3):\n"
+        "            g = jax.jit(lambda x: x * k)\n"
+        "            xs = g(xs)\n"
+        "    return xs\n",
+        encoding="utf-8",
+    )
+    result = run_lint(tmp_path, rule_names=["recompile-hazard"])
+    msgs = [f.message for f in result.findings]
+    assert len(msgs) == 1
+    assert "captures loop variable `k`" in msgs[0]
+
+
+def test_recompile_hazard_skips_deferred_builders(tmp_path):
+    # A def (or lambda) inside a loop DEFERS execution — the
+    # ProgramSpec-builder idiom must not gate; and partial(jax.jit, ...)
+    # invoked inline only BUILDS the wrapper (the sanctioned bind-once
+    # factory), it traces nothing.
+    (tmp_path / "deferred.py").write_text(
+        "import jax\n"
+        "from functools import partial\n\n\n"
+        "def make_specs(fns):\n"
+        "    specs = []\n"
+        "    for fn in fns:\n"
+        "        def build(fn=fn):\n"
+        "            return jax.jit(fn)\n"
+        "        specs.append(build)\n"
+        "        deferred = lambda: jax.jit(fn)\n"
+        "        specs.append(deferred)\n"
+        "    return specs\n\n\n"
+        "class Holder:\n"
+        "    def __init__(self, step):\n"
+        "        self.step = partial(jax.jit, donate_argnums=(0,))(step)\n",
+        encoding="utf-8",
+    )
+    result = run_lint(tmp_path, rule_names=["recompile-hazard"])
+    assert [f.message for f in result.findings] == []
+
+
+def test_changed_only_intersects_explicit_paths(lint_repo, capsys):
+    # Explicit path args compose as a FILTER within the changed set: a
+    # pre-commit hook scoped to one subsystem must not fail on unrelated
+    # changed files elsewhere in the tree.
+    for name in ("replay/donate.py", "progs.py"):
+        p = lint_repo / name
+        p.write_text(p.read_text() + "\n# touched\n", encoding="utf-8")
+    rc = lint_cli.main(
+        ["--changed-only", "HEAD", "--root", str(lint_repo),
+         str(lint_repo / "replay")]
+    )
+    assert rc == 0  # the dirty progs.py changed too, but is out of scope
+    capsys.readouterr()
+    rc = lint_cli.main(
+        ["--changed-only", "HEAD", "--root", str(lint_repo),
+         str(lint_repo / "progs.py")]
+    )
+    assert rc == 2
+    assert "recompile-hazard" in capsys.readouterr().out
+
+
+def test_changed_only_explicit_scope_nothing_changed(lint_repo, capsys):
+    # Only the out-of-scope file changed: the scoped run lints nothing
+    # and says so (exit 0), instead of failing on the unrelated change.
+    progs = lint_repo / "progs.py"
+    progs.write_text(progs.read_text() + "\n# touched\n", encoding="utf-8")
+    rc = lint_cli.main(
+        ["--changed-only", "HEAD", "--root", str(lint_repo),
+         str(lint_repo / "replay")]
+    )
+    assert rc == 0
+    assert "nothing to lint" in capsys.readouterr().out
+
+
+def test_git_changed_files_diff_relative_config(lint_repo):
+    # Under `git config diff.relative true`, `git diff --name-only` from
+    # a subdir prints SUBDIR-relative paths: the diff must run at the
+    # toplevel so joining against it stays correct — a mis-join here
+    # silently lints nothing and reads as green.
+    from distributed_ddpg_tpu.analysis.engine import git_changed_files
+
+    _git(lint_repo, "config", "diff.relative", "true")
+    donate = lint_repo / "replay" / "donate.py"
+    donate.write_text(donate.read_text() + "\n# touched\n", encoding="utf-8")
+    changed = git_changed_files(lint_repo / "replay", "HEAD")
+    assert changed == [str(donate)]
+
+
+def test_git_changed_files_untracked_from_subdir(lint_repo):
+    # `git ls-files --others` prints cwd-relative paths: untracked files
+    # must still resolve when the lint root sits DEEPER than the git
+    # toplevel (the default package-root invocation).
+    from distributed_ddpg_tpu.analysis.engine import git_changed_files
+
+    fresh = lint_repo / "replay" / "fresh.py"
+    fresh.write_text("x = 1\n", encoding="utf-8")
+    changed = git_changed_files(lint_repo / "replay", "HEAD")
+    assert changed == [str(fresh)]

@@ -1,0 +1,512 @@
+"""Layer-2 program-contract analyzer tests (analysis/programs.py,
+tools/proganalyze; docs/ANALYSIS.md "Layer 2").
+
+The acceptance contract, pinned:
+- the LIVE tree is clean — every registered program spec traces, every
+  donated leaf aliases, every golden fingerprint in
+  tests/golden_programs/ matches — inside a 30 s compile-free tracing
+  budget;
+- each deliberately-broken fixture program (tests/program_fixtures.py:
+  unaliased donation, collective reorder, host-callback leak)
+  INDEPENDENTLY drives exit 2 with a finding naming the program and the
+  primitive/buffer;
+- the golden workflow roundtrips: --update-golden writes, a check run
+  agrees, a tampered golden gates, stale goldens are flagged and pruned.
+
+Unlike tests/test_lint.py this file traces real jitted programs, so it
+rides the conftest 8-virtual-device CPU platform — but nothing here ever
+compiles or executes one.
+"""
+
+import json
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import program_fixtures as fx  # noqa: E402  (tests dir on sys.path)
+from distributed_ddpg_tpu.analysis import programs as prog_lib  # noqa: E402
+from distributed_ddpg_tpu.tools import proganalyze as prog_cli  # noqa: E402
+from distributed_ddpg_tpu.tools import runs as runs_cli  # noqa: E402
+
+TESTS = Path(__file__).resolve().parent
+REPO = TESTS.parent
+GOLDEN = TESTS / "golden_programs"
+FIXMOD = str(TESTS / "program_fixtures.py")
+
+
+def cli(args, tmp_path, name="report.json"):
+    """In-process CLI run returning (rc, report-JSON)."""
+    out = tmp_path / name
+    rc = prog_cli.main(["--json", str(out), *args])
+    return rc, json.loads(out.read_text(encoding="utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# the live tree (acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def test_live_tree_clean_with_committed_goldens(tmp_path):
+    rc, rep = cli([], tmp_path)
+    assert rc == 0, rep["findings"]
+    assert rep["counts"]["findings"] == 0
+    # Every registered program spec has a committed golden — and no
+    # golden outlives its program (the stale sweep ran and was silent).
+    names = {p["name"] for p in rep["programs"]}
+    assert names == {p.stem for p in GOLDEN.glob("*.json")}
+    assert len(names) >= 18
+    # Compile-free tracing budget: analysis time only (not the jax
+    # import), so box contention can't red it.
+    assert rep["elapsed_s"] < 30.0
+
+
+def test_every_spec_module_is_watched_by_changed_only():
+    # programs.SPEC_MODULES (what default_specs imports) and
+    # proganalyze._OWNER_FILES (what --changed-only watches without
+    # importing jax) must stay in lockstep.
+    module_files = {
+        m.replace(".", "/") + ".py" for m in prog_lib.SPEC_MODULES
+    }
+    assert module_files == set(prog_cli._OWNER_FILES)
+    # Every spec's declared owner resolves to a watched file.
+    for spec in prog_lib.default_specs():
+        assert "distributed_ddpg_tpu/" + spec.owner in module_files, spec.name
+
+
+def test_guarded_variants_share_golden_collective_order():
+    # The guarded and unguarded chunk dispatch at the same lockstep site:
+    # their committed goldens must agree on the collective subsequence.
+    for base in (
+        "learner.chunk.hostfed",
+        "learner.chunk.uniform",
+        "learner.chunk.per",
+        "learner.chunk.uniform.sharded",
+        "learner.chunk.per.sharded",
+    ):
+        a = json.loads((GOLDEN / f"{base}.json").read_text(encoding="utf-8"))
+        b = json.loads(
+            (GOLDEN / f"{base}.guarded.json").read_text(encoding="utf-8")
+        )
+        assert a["collectives"] == b["collectives"], base
+        assert a["fingerprint"] == b["fingerprint"], base
+
+
+def test_golden_schema():
+    for p in sorted(GOLDEN.glob("*.json")):
+        obj = json.loads(p.read_text(encoding="utf-8"))
+        assert obj["program"] == p.stem
+        assert isinstance(obj["collectives"], list)
+        assert obj["fingerprint"] == prog_lib.fingerprint(obj["collectives"])
+
+
+# ---------------------------------------------------------------------------
+# tracing internals
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_is_order_sensitive():
+    ab = prog_lib.fingerprint(["psum[data]", "pmax[data]"])
+    ba = prog_lib.fingerprint(["pmax[data]", "psum[data]"])
+    assert ab != ba
+    assert ab == prog_lib.fingerprint(["psum[data]", "pmax[data]"])
+
+
+def test_walk_finds_collectives_inside_scan():
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_ddpg_tpu.parallel.mesh import shard_map
+
+    mesh = prog_lib.probe_mesh()
+
+    def body(xs):
+        def step(c, x):
+            return c + jax.lax.psum(x, "data"), ()
+
+        out, _ = jax.lax.scan(step, xs[0], xs)
+        return out
+
+    fn = jax.jit(shard_map(body, mesh, in_specs=P(None, "data"),
+                           out_specs=P("data")))
+    built = prog_lib.BuiltProgram(fn, (np.zeros((3, 8), np.float32),))
+    collectives, callbacks, n_eqns = prog_lib.trace_program(built)
+    assert collectives == ["psum[data]"]  # found through scan + shard_map
+    assert not callbacks
+    assert n_eqns > 0
+
+
+# ---------------------------------------------------------------------------
+# the three broken fixtures (acceptance pin: each independently exits 2)
+# ---------------------------------------------------------------------------
+
+
+def test_unaliased_donation_drives_exit_2(tmp_path):
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:broken_donation_specs",
+         "--golden", str(tmp_path / "g"), "--update-golden"],
+        tmp_path,
+    )
+    assert rc == 2
+    assert len(rep["findings"]) == 1
+    f = rep["findings"][0]
+    assert f["check"] == "donation-aliasing"
+    assert f["program"] == "fixture.donation.unaliased"
+    assert "7xf32" in f["message"]  # names the unaliasable buffer
+
+
+def test_callback_leak_drives_exit_2(tmp_path):
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:broken_callback_specs",
+         "--golden", str(tmp_path / "g"), "--update-golden"],
+        tmp_path,
+    )
+    assert rc == 2
+    assert len(rep["findings"]) == 1
+    f = rep["findings"][0]
+    assert f["check"] == "host-callback"
+    assert f["program"] == "fixture.callback.leak"
+    assert "pure_callback" in f["message"]  # names the primitive
+
+
+def test_collective_reorder_drives_exit_2(tmp_path):
+    g = tmp_path / "g"
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:collective_specs_v1",
+         "--golden", str(g), "--update-golden"],
+        tmp_path, "update.json",
+    )
+    assert rc == 0 and rep["updated"] == ["fixture.collective.pair"]
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:collective_specs_v2", "--golden", str(g)],
+        tmp_path, "check.json",
+    )
+    assert rc == 2
+    assert len(rep["findings"]) == 1
+    f = rep["findings"][0]
+    assert f["check"] == "collective-order"
+    assert f["program"] == "fixture.collective.pair"
+    # The finding shows both orders, naming the reordered primitives.
+    assert "psum[data]" in f["message"] and "pmax[data]" in f["message"]
+
+
+def test_beat_group_divergence_gates(tmp_path):
+    rep = prog_lib.analyze(
+        fx.broken_beat_group_specs(), tmp_path / "g", update_golden=True
+    )
+    checks = [f.check for f in rep.findings]
+    assert checks == ["beat-group"]
+    assert "fixture-beat" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# the golden workflow
+# ---------------------------------------------------------------------------
+
+
+def test_missing_golden_gates(tmp_path):
+    rep = prog_lib.analyze(fx.clean_specs(), tmp_path / "empty")
+    assert [f.check for f in rep.findings] == ["collective-order"]
+    assert "no golden fingerprint" in rep.findings[0].message
+
+
+def test_update_golden_roundtrip(tmp_path):
+    g = tmp_path / "g"
+    rep = prog_lib.analyze(fx.clean_specs(), g, update_golden=True)
+    assert not rep.findings and rep.updated == ["fixture.clean"]
+    golden = json.loads(
+        (g / "fixture.clean.json").read_text(encoding="utf-8")
+    )
+    assert golden["collectives"] == ["psum[data]"]
+    # A check run agrees; a second update is a no-op (nothing re-listed).
+    assert not prog_lib.analyze(fx.clean_specs(), g).findings
+    assert prog_lib.analyze(fx.clean_specs(), g,
+                            update_golden=True).updated == []
+    # Tamper with the committed order -> the gate fires; re-update heals.
+    golden["collectives"] = ["pmax[data]", "psum[data]"]
+    (g / "fixture.clean.json").write_text(json.dumps(golden),
+                                          encoding="utf-8")
+    rep = prog_lib.analyze(fx.clean_specs(), g)
+    assert [f.check for f in rep.findings] == ["collective-order"]
+    rep = prog_lib.analyze(fx.clean_specs(), g, update_golden=True)
+    assert rep.updated == ["fixture.clean"]
+    assert not prog_lib.analyze(fx.clean_specs(), g).findings
+
+
+def test_stale_golden_flagged_and_pruned(tmp_path):
+    g = tmp_path / "g"
+    prog_lib.analyze(fx.clean_specs(), g, update_golden=True)
+    prog_lib.write_golden(g, "fixture.retired", ["psum[data]"])
+    rep = prog_lib.analyze(fx.clean_specs(), g)
+    assert [(f.check, f.program) for f in rep.findings] == [
+        ("stale-golden", "fixture.retired")
+    ]
+    # A SCOPED run must not flag goldens of programs it never looked at.
+    rep = prog_lib.analyze(fx.clean_specs(), g, only=["fixture.clean"])
+    assert not rep.findings
+    # --update-golden prunes and reports the retirement.
+    rep = prog_lib.analyze(fx.clean_specs(), g, update_golden=True)
+    assert rep.updated == ["-fixture.retired"]
+    assert not (g / "fixture.retired.json").exists()
+    assert not prog_lib.analyze(fx.clean_specs(), g).findings
+
+
+def test_alternate_specs_registry_never_sweeps_live_goldens(tmp_path):
+    # An alternate --specs registry covers NONE of the live programs:
+    # against a golden dir holding other programs' goldens the stale
+    # sweep must stay silent, and --update-golden must not PRUNE them —
+    # the documented fixture invocation uses the default golden dir, so
+    # a sweep here would flag (and a prune would delete) every
+    # committed live golden.
+    g = tmp_path / "g"
+    prog_lib.write_golden(g, "live.program", ["psum[data]"])
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:clean_specs", "--golden", str(g)],
+        tmp_path, "check.json",
+    )
+    checks = {f["check"] for f in rep["findings"]}
+    assert "stale-golden" not in checks
+    assert checks == {"collective-order"}  # only the missing fixture golden
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:clean_specs", "--golden", str(g),
+         "--update-golden"],
+        tmp_path, "update.json",
+    )
+    assert rc == 0 and rep["updated"] == ["fixture.clean"]
+    assert (g / "live.program.json").exists()  # survived the update
+
+
+def test_build_error_is_a_finding(tmp_path):
+    def boom():
+        raise RuntimeError("spec cannot build")
+
+    rep = prog_lib.analyze(
+        [prog_lib.ProgramSpec("fixture.broken.build", "x.py", boom)],
+        tmp_path / "g",
+    )
+    assert [f.check for f in rep.findings] == ["build-error"]
+    assert "spec cannot build" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_usage_errors(tmp_path):
+    assert prog_cli.main(["--programs", "no.such.program",
+                          "--specs", f"{FIXMOD}:clean_specs"]) == 1
+    assert prog_cli.main(["--specs", str(tmp_path / "missing.py")]) == 1
+
+
+def test_cli_scoped_run_matches_glob(tmp_path):
+    g = tmp_path / "g"
+    rc, _ = cli(
+        ["--specs", f"{FIXMOD}:broken_beat_group_specs",
+         "--golden", str(g), "--update-golden"],
+        tmp_path, "update.json",
+    )
+    assert rc == 2  # the beat-group divergence
+    # Scoped to one variant the group check sees a single member: clean.
+    rc, rep = cli(
+        ["--specs", f"{FIXMOD}:broken_beat_group_specs",
+         "--golden", str(g), "--programs", "fixture.beat.a"],
+        tmp_path, "scoped.json",
+    )
+    assert rc == 0
+    assert [p["name"] for p in rep["programs"]] == ["fixture.beat.a"]
+
+
+def test_cli_list(capsys):
+    assert prog_cli.main(
+        ["--list", "--specs", f"{FIXMOD}:broken_beat_group_specs"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "fixture.beat.a" in out and "beat:fixture-beat" in out
+
+
+# ---------------------------------------------------------------------------
+# --changed-only scoping (jax-free fast path)
+# ---------------------------------------------------------------------------
+
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.name=t",
+         "-c", "user.email=t@t", *args],
+        check=True, capture_output=True, timeout=30,
+    )
+
+
+@pytest.fixture()
+def fake_repo(tmp_path, monkeypatch):
+    repo = (tmp_path / "repo").resolve()
+    for rel in (
+        "distributed_ddpg_tpu/parallel/learner.py",
+        "distributed_ddpg_tpu/ondevice.py",
+        "README.md",
+    ):
+        p = repo / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("x = 1\n", encoding="utf-8")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "seed")
+    monkeypatch.setattr(prog_cli, "_REPO_ROOT", repo)
+    return repo
+
+
+def test_changed_only_nothing_relevant(fake_repo):
+    assert prog_cli._changed_scope("HEAD") == []
+    (fake_repo / "README.md").write_text("y = 2\n", encoding="utf-8")
+    assert prog_cli._changed_scope("HEAD") == []
+
+
+def test_changed_only_scopes_to_owner_files(fake_repo):
+    (fake_repo / "distributed_ddpg_tpu" / "parallel" / "learner.py"
+     ).write_text("x = 2\n", encoding="utf-8")
+    assert prog_cli._changed_scope("HEAD") == [
+        "distributed_ddpg_tpu/parallel/learner.py"
+    ]
+
+
+def test_changed_only_analyzer_change_invalidates_everything(fake_repo):
+    # An untracked file under analysis/ -> full run (None = no scoping).
+    p = fake_repo / "distributed_ddpg_tpu" / "analysis" / "programs.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("x = 1\n", encoding="utf-8")
+    assert prog_cli._changed_scope("HEAD") is None
+
+
+def test_changed_only_bad_ref_errors(fake_repo):
+    with pytest.raises(RuntimeError, match="--changed-only"):
+        prog_cli._changed_scope("no-such-ref")
+    assert prog_cli.main(["--changed-only", "no-such-ref"]) == 1
+
+
+def test_changed_only_exit_0_without_jax_work(fake_repo, capsys):
+    # Nothing relevant changed: the CLI exits 0 before loading any spec.
+    assert prog_cli.main(["--changed-only", "HEAD"]) == 0
+    assert "nothing to analyze" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# tools.runs programs digest
+# ---------------------------------------------------------------------------
+
+
+def test_runs_programs_digest(tmp_path, capsys):
+    g = tmp_path / "g"
+    _, rep = cli(
+        ["--specs", f"{FIXMOD}:collective_specs_v1",
+         "--golden", str(g), "--update-golden"],
+        tmp_path, "clean.json",
+    )
+    assert runs_cli.main(["programs", str(tmp_path / "clean.json")]) == 0
+    out = capsys.readouterr().out
+    assert "PROGRAMS PASS" in out and "fixture.collective.pair" in out
+
+    cli(["--specs", f"{FIXMOD}:collective_specs_v2", "--golden", str(g)],
+        tmp_path, "dirty.json")
+    assert runs_cli.main(["programs", str(tmp_path / "dirty.json")]) == 2
+    out = capsys.readouterr().out
+    assert "PROGRAMS FAIL" in out and "collective-order" in out
+
+
+def test_runs_programs_digest_bad_inputs(tmp_path, capsys):
+    assert runs_cli.main(["programs", str(tmp_path / "nope.json")]) == 1
+    trunc = tmp_path / "trunc.json"
+    trunc.write_text("[]", encoding="utf-8")
+    assert runs_cli.main(["programs", str(trunc)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# gate scripts
+# ---------------------------------------------------------------------------
+
+
+def test_proganalyze_gate_script_fails_on_findings(tmp_path):
+    json_path = tmp_path / "program_findings.json"
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "proganalyze_gate.sh"),
+         "--specs", f"{FIXMOD}:broken_donation_specs",
+         "--golden", str(tmp_path / "g"), "--update-golden"],
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "PROGRAM_JSON": str(json_path)},
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "tools.runs programs" in proc.stderr
+    rep = json.loads(json_path.read_text(encoding="utf-8"))
+    assert rep["findings"][0]["check"] == "donation-aliasing"
+
+
+def test_proganalyze_gate_script_skips_without_analyzer(tmp_path):
+    # Old baselines predate Layer 2: the gate must SKIP, not fail.
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    gate = scripts / "proganalyze_gate.sh"
+    gate.write_text(
+        (REPO / "scripts" / "proganalyze_gate.sh").read_text()
+    )
+    proc = subprocess.run(
+        ["bash", str(gate)],
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin"},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "SKIP" in proc.stderr
+
+
+@pytest.mark.slow
+def test_ci_gate_programs_prestep_runs_before_usage_check():
+    # `ci_gate.sh --programs` with no candidate: the program gate runs on
+    # the real tree (the wiring pin), then the usage error exits 1 — not
+    # the gate's 2 (the live tree is clean).
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "ci_gate.sh"), "--programs"],
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    assert "programs," in proc.stdout  # the analyzer summary ran first
+
+
+def test_changed_only_composes_with_programs_glob(fake_repo, capsys):
+    # A glob that matches programs of UNCHANGED modules must say so, not
+    # analyze zero programs and read green silently.
+    (fake_repo / "distributed_ddpg_tpu" / "ondevice.py").write_text(
+        "x = 2\n", encoding="utf-8"
+    )
+    assert prog_cli.main(
+        ["--changed-only", "HEAD", "--programs", "learner.*"]
+    ) == 0
+    assert "nothing to analyze" in capsys.readouterr().out
+    # With the owner changed, the glob composes as a filter in scope.
+    (fake_repo / "distributed_ddpg_tpu" / "parallel" / "learner.py"
+     ).write_text("x = 2\n", encoding="utf-8")
+    rc = prog_cli.main(
+        ["--changed-only", "HEAD", "--programs", "learner.chunk.hostfed"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "1 program" in out
+
+
+def test_out_of_range_donated_index_gates(tmp_path):
+    import numpy as np
+
+    def build():
+        fn = jax.jit(lambda x: x + 1.0)
+        return prog_lib.BuiltProgram(fn, (np.zeros(3, np.float32),), (5,))
+
+    rep = prog_lib.analyze(
+        [prog_lib.ProgramSpec("fixture.donated.drift", "x.py", build)],
+        tmp_path / "g",
+    )
+    assert [f.check for f in rep.findings] == ["build-error"]
+    assert "out of range" in rep.findings[0].message
