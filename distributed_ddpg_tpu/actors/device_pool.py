@@ -231,6 +231,12 @@ class DeviceActorPool:
         # DeviceReplay's donated insert expects against its replicated
         # storage (and what makes multi-host replicas bit-identical).
         rows_sharding = NamedSharding(self.mesh, P(None, None))
+        # Pure rollout body, kept for composition inside LARGER jitted
+        # programs (the fused megastep, parallel/megastep.py): the fused
+        # beat calls it on the freshly-updated actor params in the same
+        # program, so its rows land with zero extra dispatches. The jitted
+        # wrapper below stays the standalone (warmup / unfused) path.
+        self._rollout_fn = rollout
         # Params keep whatever sharding the learner's live tree carries
         # (replicated, or TP-sharded under model_axis > 1): no in_shardings
         # pin, so the pointer-swap refresh never pays a resharding copy.
@@ -319,6 +325,27 @@ class DeviceActorPool:
             file=sys.stderr, flush=True,
         )
         return True
+
+    # --- fused-megastep composition (parallel/megastep.py) ---
+
+    @property
+    def rollout_fn(self):
+        """The pure rollout body — (params, carry) -> (carry, rows[K*E, D])
+        — for composition inside the fused megastep's beat program. Same
+        function the standalone jit wraps, so the fused and unfused row
+        streams are bit-identical for the same params/carry/key."""
+        return self._rollout_fn
+
+    def absorb_fused_chunk(self, carry: ActorCarry, dur_s: float) -> None:
+        """Install the rollout carry returned by a fused megastep beat and
+        advance the host counters exactly as run_chunk would. The rollout
+        ran INSIDE the beat program, so there is no separate dispatch to
+        time — dur_s is the whole beat, and devactor_chunk_ms equals the
+        fused beat time in fused mode (docs/FUSED_BEAT.md)."""
+        self._carry = carry
+        self._stats.record_chunk(self.rows_per_chunk, dur_s)
+        self._dispatches += 1
+        self._steps += self.rows_per_chunk
 
     # --- rollout-state checkpointing (docs/DEVICE_ACTORS.md) ---
 

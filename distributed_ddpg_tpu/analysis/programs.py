@@ -561,6 +561,7 @@ def analyze(
 # CLI keys on the owner paths these specs declare.
 SPEC_MODULES = (
     "distributed_ddpg_tpu.parallel.learner",
+    "distributed_ddpg_tpu.parallel.megastep",
     "distributed_ddpg_tpu.replay.device",
     "distributed_ddpg_tpu.actors.device_pool",
     "distributed_ddpg_tpu.serve.server",

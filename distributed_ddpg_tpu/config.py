@@ -231,6 +231,23 @@ class DDPGConfig:
     # learner_chunk's resolution discipline).
     device_actor_chunk: int = 0
 
+    # --- fused training megastep (parallel/megastep.py; docs/FUSED_BEAT.md) ---
+    # Anakin-style fused beat (PAPERS.md arXiv 2104.06272): compile the
+    # whole rollout -> ring-scatter -> sample -> K-learner-updates beat
+    # into ONE jitted program per loop iteration, so the host dispatches a
+    # single program per beat (zero host round-trips inside it) instead of
+    # three. Composes the device-actor rollout, the DeviceReplay insert
+    # (replicated or sharded), and the learner's XLA-scan sampling chunk —
+    # guarded or unguarded: the PR-7 guardrail probe threads through the
+    # fused program, so guardrails=True keeps the fast path. "auto"
+    # (default): fuse whenever actor_backend='device' on the device-replay
+    # path with free-running ratios and the Pallas megakernel inactive
+    # (the kernel has no rollout/probe slot inside a larger program);
+    # "on": require it (config error when the composition is impossible);
+    # "off": always dispatch per phase. Bit-identical to the separate
+    # dispatch sequence for fixed seeds (tests/test_megastep.py).
+    fused_beat: str = "auto"
+
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15
     ou_sigma: float = 0.2
@@ -862,6 +879,48 @@ class DDPGConfig:
                     "scatter insert would write duplicate ring positions "
                     "in unspecified order. Shrink the chunk/env count or "
                     "grow the replay"
+                )
+        if self.fused_beat not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fused_beat must be 'auto', 'on', or 'off', got "
+                f"{self.fused_beat!r}"
+            )
+        if self.fused_beat == "on":
+            # The fused megastep composes the device-actor rollout, the
+            # device-replay insert, and the learner chunk into one program
+            # (docs/FUSED_BEAT.md); every leg must exist. The device-actor
+            # validation above already rejects n_step > 1, serve_actors,
+            # host_replay, and strict_sync for actor_backend='device', so
+            # those combinations fail through their own messages.
+            if self.backend != "jax_tpu":
+                raise ValueError(
+                    "fused_beat='on' fuses the jax_tpu training loop; the "
+                    "native backend has no device programs and "
+                    "jax_ondevice is already a fused monolith (ondevice.py)"
+                )
+            if self.actor_backend != "device":
+                raise ValueError(
+                    "fused_beat='on' needs the on-device rollout leg "
+                    "(actor_backend='device'): host actor processes step "
+                    "envs outside XLA and cannot be compiled into the "
+                    "beat — use the dispatch-per-phase loop for host "
+                    "actors"
+                )
+            if self.fused_chunk == "on":
+                raise ValueError(
+                    "fused_beat='on' composes the XLA scan sampling chunk "
+                    "(the Pallas megakernel has no rollout/probe slot "
+                    "inside a larger traced program) — incompatible with "
+                    "fused_chunk='on'; use 'auto' or 'off'"
+                )
+            if self.max_ingest_ratio > 0.0 or self.max_learn_ratio > 0.0:
+                raise ValueError(
+                    "fused_beat='on' fixes the rollout:learn ratio inside "
+                    "one program (device_actor_envs x chunk rows per "
+                    "learner_chunk steps, every beat); the "
+                    "max_ingest_ratio/max_learn_ratio gates need "
+                    "independently dispatchable phases to throttle — "
+                    "disable the gates or use fused_beat='auto'/'off'"
                 )
         # Fail fast on fault-grammar typos: a bad spec must die at config
         # parse, not hours later when the fault was scheduled to fire.

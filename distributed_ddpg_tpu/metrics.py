@@ -430,6 +430,80 @@ class DevActorStats:
         return out
 
 
+class FusedBeatStats:
+    """Counters for the fused training megastep (parallel/megastep.py;
+    docs/FUSED_BEAT.md) — the `fused_*` family every train/final JSONL
+    record carries when the fused beat is active. All interval-scoped
+    (each record describes its own window, the DevActorStats discipline);
+    single-threaded by construction (only the learner thread dispatches
+    beats), locked anyway like its siblings:
+
+      fused_beats           fused beat dispatches in the interval
+      fused_steps_per_s     learner grad steps retired over the interval
+                            (the BENCH_FUSED headline / ci_gate key)
+      fused_rows_per_s      rollout transition rows landed over the
+                            interval (the beat's in-program insert)
+      fused_beat_ms         mean wall time per beat dispatch (enqueue +
+                            donated-carry sync, one program per beat)
+      fused_beat_p50/p95/max
+                            reservoir tails of the same (a p95 spike
+                            means the single beat program started
+                            synchronizing against the host)
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._t0 = time.monotonic()
+        self._beats = 0
+        self._steps = 0
+        self._rows = 0
+        self._dur_s = 0.0
+        self._res = _Reservoir(
+            PhaseTimers.RESERVOIR_K,
+            (zlib.crc32(b"fused_beat") ^ seed) & 0x7FFFFFFF,
+        )
+
+    def record_beat(self, learn_steps: int, rows: int, dur_s: float) -> None:
+        with self._lock:
+            self._beats += 1
+            self._steps += int(learn_steps)
+            self._rows += int(rows)
+            self._dur_s += dur_s
+            self._res.add(dur_s)
+
+    def snapshot(self, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            dt = max(time.monotonic() - self._t0, 1e-9)
+            n = self._beats
+            out = {
+                "fused_beats": n,
+                "fused_steps_per_s": round(self._steps / dt, 1),
+                "fused_rows_per_s": round(self._rows / dt, 1),
+                "fused_beat_ms": (
+                    round(1000.0 * self._dur_s / n, 3) if n else 0.0
+                ),
+                "fused_beat_p50": round(
+                    1000.0 * self._res.percentile(0.50), 3
+                ),
+                "fused_beat_p95": round(
+                    1000.0 * self._res.percentile(0.95), 3
+                ),
+                "fused_beat_max": round(1000.0 * self._res.max, 3),
+            }
+            if reset:
+                self._t0 = time.monotonic()
+                self._beats = 0
+                self._steps = 0
+                self._rows = 0
+                self._dur_s = 0.0
+                self._res = _Reservoir(
+                    PhaseTimers.RESERVOIR_K,
+                    (zlib.crc32(b"fused_beat") ^ self._seed) & 0x7FFFFFFF,
+                )
+        return out
+
+
 class TransferStats:
     """Thread-safe counters for the unified transfer scheduler
     (transfer/scheduler.py; docs/TRANSFER.md) — the scheduler-level

@@ -732,6 +732,23 @@ class ShardedLearner:
             )
             self._scan_per_sample_chunk_step = self._per_sample_chunk_step
 
+        # --- fused-megastep composition (parallel/megastep.py) ---
+        # The pure (unjitted) XLA-scan sampling bodies, for composition
+        # into the fused beat program. Always the SCAN variants: the
+        # megastep composes whole-chunk bodies, and the Pallas megakernel
+        # has no slot inside a larger traced program. Rebuilt with every
+        # _build_programs call (LR backoff, support expansion), so the
+        # version counter below lets the megastep detect staleness and
+        # rebuild its beat program in step.
+        self._pure_scan_fns = {
+            "uniform": scan_sample_chunk_fn,
+            "per": per_sample_chunk_fn,
+        }
+        if self.guard_enabled:
+            self._pure_scan_fns["uniform.guarded"] = guard_sample_chunk_fn
+            self._pure_scan_fns["per.guarded"] = guard_per_sample_chunk_fn
+        self.programs_version = getattr(self, "programs_version", 0) + 1
+
         self.fused_chunk_error: Optional[str] = None
         if prior_kernel_error is not None:
             # Stay degraded (see note at the top of this method) — same
@@ -1011,6 +1028,28 @@ class ShardedLearner:
             self.state = out.state
             device_replay.set_per_state(new_p, new_maxp)
             return out
+
+    # --- fused-megastep composition hooks (parallel/megastep.py) ---
+
+    def pure_scan_sample_fn(self, per: bool):
+        """The pure scan-path sampling-chunk body matching this learner's
+        guard mode — uniform: (state, key, storage, size[, guard]);
+        PER: (state, key, storage, size, priorities, maxp, beta, alpha,
+        eps[, guard]). The fused megastep composes it with the rollout and
+        ring insert into one beat program; using the identical body is
+        what makes fused-vs-separate dispatch bit-identity hold."""
+        key = ("per" if per else "uniform") + (
+            ".guarded" if self.guard_enabled else ""
+        )
+        return self._pure_scan_fns[key]
+
+    def note_fused_health(self, guard, health, bad_idx) -> None:
+        """Install the guard state + health word a fused megastep beat
+        returned, so poll_health()/bad_indices() (the train.py guardrail
+        monitor) read the fused program's probe exactly as they read a
+        standalone guarded chunk's."""
+        self._guard = guard
+        self._health_cur = (health, bad_idx)
 
     # --- host-side views ---
 

@@ -285,6 +285,11 @@ class DeviceReplay:
             new_size = jnp.minimum(size + m, self.capacity)
             return storage, new_ptr, new_size
 
+        # Pure insert body, kept for composition inside LARGER jitted
+        # programs (the fused megastep, parallel/megastep.py) — the jitted
+        # wrappers below own donation/shardings for standalone dispatch.
+        self._insert_pure = _insert_impl
+
         # One jitted program per super-block shape; shapes are restricted
         # to power-of-two multiples of block_size (_coalesce_k), so the
         # jit cache holds at most log2(max_coalesce)+1 entries. In sharded
@@ -1095,32 +1100,63 @@ class DeviceReplay:
             self._insert_grouped_cache[m] = fn
         return fn
 
+    def _make_insert_replrows_body(self, m: int):
+        """Pure sharded insert for an m-row REPLICATED device block: every
+        shard already holds the whole block, so each just gathers its
+        owned rows (offset j with j % N == shard — ptr-aligned) and
+        scatters them into its contiguous local run. No collective, no
+        host bytes. Shared by the jitted standalone insert below and the
+        fused-megastep composition (pure_insert_device_rows_fn)."""
+        from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+        n, sc, cap = self._n_shards, self._shard_cap, self.capacity
+
+        def body(st, rows, ptr, size):
+            s = jax.lax.axis_index("data")
+            mine = rows[s + jnp.arange(m // n, dtype=jnp.int32) * n]
+            start = ptr // n
+            slots = (start + jnp.arange(m // n, dtype=jnp.int32)) % sc
+            st = st.at[slots].set(mine)
+            return st, (ptr + m) % cap, jnp.minimum(size + m, cap)
+
+        return mesh_lib.shard_map(
+            body, self._mesh,
+            in_specs=(P("data", None), P(), P(), P()),
+            out_specs=(P("data", None), P(), P()),
+        )
+
+    def pure_insert_device_rows_fn(self, m: int):
+        """Pure (unjitted) insert body for an m-row ALREADY-DEVICE-RESIDENT
+        replicated block — (storage, rows, ptr, size) -> (storage, ptr,
+        size) with the exact math insert_device_rows dispatches, for
+        composition inside a larger jitted program (the fused megastep,
+        parallel/megastep.py; docs/FUSED_BEAT.md). The caller owns
+        donation and the host-side bookkeeping (note_device_rows)."""
+        if not self.sharded:
+            return self._insert_pure
+        if m % self._n_shards:
+            raise ReplayUsageError(
+                f"pure_insert_device_rows_fn: {m} rows do not divide over "
+                f"{self._n_shards} shards (the insert_device_rows "
+                "alignment invariant)"
+            )
+        return self._make_insert_replrows_body(m)
+
+    def note_device_rows(self, m: int) -> None:
+        """Advance the host-side source-attribution mirror past m device-
+        produced rows landed by an EXTERNAL program's in-program insert
+        (the fused megastep) — the same bookkeeping insert_device_rows
+        does after its own scatter. Caller holds dispatch_lock."""
+        self._note_shipped(None, None, m)
+
     def _get_insert_replrows(self, m: int):
         """Compiled sharded insert for an m-row REPLICATED device block
-        (the device-actor path, insert_device_rows): every shard already
-        holds the whole block, so each just gathers its owned rows
-        (offset j with j % N == shard — ptr-aligned) and scatters them
-        into its contiguous local run. No collective, no host bytes."""
+        (the device-actor path, insert_device_rows): the jitted/donating
+        wrapper over _make_insert_replrows_body."""
         fn = self._insert_replrows_cache.get(m)
         if fn is None:
-            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
-
-            n, sc, cap = self._n_shards, self._shard_cap, self.capacity
-
-            def body(st, rows, ptr, size):
-                s = jax.lax.axis_index("data")
-                mine = rows[s + jnp.arange(m // n, dtype=jnp.int32) * n]
-                start = ptr // n
-                slots = (start + jnp.arange(m // n, dtype=jnp.int32)) % sc
-                st = st.at[slots].set(mine)
-                return st, (ptr + m) % cap, jnp.minimum(size + m, cap)
-
             fn = jax.jit(
-                mesh_lib.shard_map(
-                    body, self._mesh,
-                    in_specs=(P("data", None), P(), P(), P()),
-                    out_specs=(P("data", None), P(), P()),
-                ),
+                self._make_insert_replrows_body(m),
                 donate_argnums=(0,),
                 in_shardings=(
                     self._storage_sharding,
@@ -1524,46 +1560,49 @@ class DevicePrioritizedReplay(DeviceReplay):
         # multiples of block_size, same bounded set as the inserts).
         self._stamp_cache = {}
 
+    def _make_stamp_body(self, m: int):
+        """Pure stamp body — (priorities, maxp, old_ptr) -> priorities —
+        shared by the jitted standalone stamp and the fused-megastep
+        composition (pure_stamp_fn)."""
+        if self.sharded:
+            # Sharded stamp: the landed positions are a contiguous
+            # logical run starting at the N-aligned old_ptr, so each
+            # shard stamps its own contiguous m/N local slots — the
+            # priority twin of _get_insert_grouped, no collective.
+            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+            n, sc = self._n_shards, self._shard_cap
+
+            def stamp_body(prios, maxp, old_ptr):
+                start = old_ptr // n
+                slots = (
+                    start + jnp.arange(m // n, dtype=jnp.int32)
+                ) % sc
+                return prios.at[slots].set(maxp)
+
+            return mesh_lib.shard_map(
+                stamp_body, self._mesh,
+                in_specs=(P("data"), P(), P()),
+                out_specs=P("data"),
+            )
+
+        def stamp(prios, maxp, old_ptr):
+            idx = (old_ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
+            return prios.at[idx].set(maxp)
+
+        return stamp
+
+    def pure_stamp_fn(self, m: int):
+        """Pure (unjitted) max-priority stamp for m freshly-landed rows,
+        for composition inside a larger jitted program (the fused
+        megastep's in-program insert stamps exactly like
+        _stamp_device_rows would after a standalone one)."""
+        return self._make_stamp_body(m)
+
     def _get_stamp(self, m: int):
         fn = self._stamp_cache.get(m)
         if fn is None:
             vec_sharding, scalar_sharding = self._stamp_shardings
-
-            if self.sharded:
-                # Sharded stamp: the landed positions are a contiguous
-                # logical run starting at the N-aligned old_ptr, so each
-                # shard stamps its own contiguous m/N local slots — the
-                # priority twin of _get_insert_grouped, no collective.
-                from distributed_ddpg_tpu.parallel import mesh as mesh_lib
-
-                n, sc = self._n_shards, self._shard_cap
-
-                def stamp_body(prios, maxp, old_ptr):
-                    start = old_ptr // n
-                    slots = (
-                        start + jnp.arange(m // n, dtype=jnp.int32)
-                    ) % sc
-                    return prios.at[slots].set(maxp)
-
-                fn = jax.jit(
-                    mesh_lib.shard_map(
-                        stamp_body, self._mesh,
-                        in_specs=(P("data"), P(), P()),
-                        out_specs=P("data"),
-                    ),
-                    donate_argnums=(0,),
-                    in_shardings=(
-                        vec_sharding, scalar_sharding, scalar_sharding
-                    ),
-                    out_shardings=vec_sharding,
-                )
-                self._stamp_cache[m] = fn
-                return fn
-
-            def stamp(prios, maxp, old_ptr):
-                idx = (old_ptr + jnp.arange(m, dtype=jnp.int32)) % self.capacity
-                return prios.at[idx].set(maxp)
-
             kwargs = (
                 dict(
                     in_shardings=(vec_sharding, scalar_sharding, scalar_sharding),
@@ -1572,7 +1611,9 @@ class DevicePrioritizedReplay(DeviceReplay):
                 if vec_sharding is not None
                 else {}
             )
-            fn = jax.jit(stamp, donate_argnums=(0,), **kwargs)
+            fn = jax.jit(
+                self._make_stamp_body(m), donate_argnums=(0,), **kwargs
+            )
             self._stamp_cache[m] = fn
         return fn
 

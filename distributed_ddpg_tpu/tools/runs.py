@@ -319,6 +319,22 @@ def summarize_run(path: str) -> Dict[str, Any]:
             }
     digest["devactor"] = devactor
 
+    # Fused-megastep digest (parallel/megastep.py FusedBeatStats;
+    # docs/FUSED_BEAT.md): beats, grad-steps/s, rows/s, and the per-beat
+    # dispatch tails — all interval-scoped (steady + worst interval).
+    fused = {}
+    fused_keys = sorted(
+        {k for r in train + final for k in r if k.startswith("fused_")}
+    )
+    for key in fused_keys:
+        vals = _col(train + final, key)
+        if vals:
+            fused[key] = {
+                "steady": _tail_mean(vals), "max": max(vals),
+                "last": vals[-1],
+            }
+    digest["fused"] = fused
+
     # Replay-placement digest (replay/device.py ReplayShardStats;
     # docs/REPLAY_SHARDING.md): measured ingest bytes/row, per-device
     # storage bytes, per-shard fill, exchange-dispatch tails.
@@ -423,6 +439,15 @@ def render_summary(digest: Dict[str, Any]) -> str:
             [
                 [k, v["steady"], v["max"], v["last"]]
                 for k, v in digest["devactor"].items()
+            ],
+        ))
+    if digest.get("fused"):
+        out.append("\n-- fused megastep (docs/FUSED_BEAT.md)")
+        out.append(render_table(
+            ["field", "steady", "max", "last"],
+            [
+                [k, v["steady"], v["max"], v["last"]]
+                for k, v in digest["fused"].items()
             ],
         ))
     if digest.get("replay_sharding"):
@@ -546,6 +571,14 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
         add(key, da.get("steady"), db.get("steady"),
             lower_better=("_ms" in key or "p95" in key or "p50" in key
                           or key.endswith("_max") or "restart" in key))
+    for key in sorted(set(a.get("fused", {})) | set(b.get("fused", {}))):
+        fa = a.get("fused", {}).get(key, {})
+        fb = b.get("fused", {}).get(key, {})
+        # Beat-dispatch latency tails (fused_beat_ms/p50/p95/max) are
+        # lower-is-better; beats and the steps/rows rates are throughput.
+        add(key, fa.get("steady"), fb.get("steady"),
+            lower_better=("_ms" in key or "p95" in key or "p50" in key
+                          or key.endswith("_max")))
     for key in sorted(
         set(a.get("replay_sharding", {})) | set(b.get("replay_sharding", {}))
     ):

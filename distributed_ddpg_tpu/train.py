@@ -901,6 +901,38 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             device_pool.load_carry_state(ckpt_meta["devactor_carry"])
         _beat()  # rollout-program construction survived
 
+    # --- fused training megastep (parallel/megastep.py; docs/FUSED_BEAT.md) ---
+    # config.fused_beat: compile rollout + ring scatter + sample + the K
+    # learner updates into ONE jitted program per steady-state iteration —
+    # the host dispatches a single beat instead of three programs, with
+    # zero host round-trips inside it. 'auto' fuses whenever the device-
+    # actor + device-replay legs exist, the ratio gates are free-running
+    # (a fused beat has a FIXED rollout:learn ratio the gates could not
+    # throttle), and the Pallas megakernel is inactive (no slot for it
+    # inside a larger program); 'on' forces it (config validation already
+    # rejected impossible compositions). Guardrails thread THROUGH the
+    # fused program (note_fused_health), so guardrails=True keeps the
+    # fast path. Warmup below still uses the standalone rollout dispatch:
+    # beats need the learner leg, which warmup by definition lacks.
+    megastep = None
+    if (
+        device_pool is not None
+        and use_device_replay
+        and config.fused_beat != "off"
+        and (
+            config.fused_beat == "on"
+            or (
+                not learner.fused_chunk_active
+                and config.max_ingest_ratio == 0.0
+                and config.max_learn_ratio == 0.0
+            )
+        )
+    ):
+        from distributed_ddpg_tpu.parallel.megastep import FusedMegastep
+
+        megastep = FusedMegastep(config, learner, device_pool, device_replay)
+        _beat()  # beat-program construction survived
+
     # Learner d2h pulls ride the scheduler's inline d2h class: absolute
     # priority (no queueing on the hot path), full transfer_* accounting.
     learner.transfer = transfer_sched
@@ -1100,6 +1132,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         dispatch tails, episode stats, and the bounded-restart counter.
         Records stay clean on the host backend."""
         return device_pool.snapshot() if device_pool is not None else {}
+
+    def fused_fields() -> Dict[str, float]:
+        """fused_* observability (metrics.FusedBeatStats;
+        docs/FUSED_BEAT.md) for every train/final record when the fused
+        megastep is active — interval beats, grad-steps/s, rows/s, and
+        the per-beat dispatch tails. Records stay clean on the
+        dispatch-per-phase loop."""
+        return megastep.snapshot() if megastep is not None else {}
 
     def _guard_quarantine_sources() -> None:
         """Bad-row -> ingest-source attribution: fetch the offending
@@ -1519,7 +1559,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     last_monitor_t = 0.0
     support_controller = support_auto.SupportController()
 
-    def after_chunk(out, indices) -> None:
+    def after_chunk(out, indices, fused: bool = False) -> None:
         nonlocal learn_steps, last_ckpt, next_refresh, last_eval
         nonlocal last_refresh_t, last_log_t
         learn_steps += chunk
@@ -1540,8 +1580,18 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         # Device rollout BEFORE the ingest beat: in bg_sync mode
         # ingest_once issues a background lockstep beat, and enqueuing the
         # rollout first keeps the per-process device-op order a pure
-        # function of the (lockstep) iteration count.
-        devactor_step()
+        # function of the (lockstep) iteration count. A FUSED beat already
+        # ran the rollout + insert inside its one program, so only the
+        # host-row ingest beat (drains + the unconditional multi-host
+        # lockstep/shard_exchange collective) remains.
+        if not fused:
+            devactor_step()
+        else:
+            # The beat's in-program rollout produced its rows without a
+            # devactor_step dispatch; keep the shared actor-rate meter
+            # (actor_steps_per_sec) fed so a healthy fused run never
+            # reads as a stalled actor fleet.
+            env_timer.tick(device_pool.rows_per_chunk)
         ingest_once(sync_wait=False)
 
         if config.prioritized and not use_device_replay:
@@ -1664,6 +1714,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 **serve_fields(),
                 # Device-actor rollouts (docs/DEVICE_ACTORS.md).
                 **devactor_fields(),
+                # Fused megastep beats (docs/FUSED_BEAT.md).
+                **fused_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -1975,7 +2027,25 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 # (docs/TRANSFER.md token protocol). No-op otherwise.
                 wait_beat()
                 if use_device_replay:
-                    if config.prioritized:
+                    if megastep is not None:
+                        # Fused megastep (docs/FUSED_BEAT.md): rollout +
+                        # scatter + sample + K updates in ONE program. The
+                        # PER beta anneal rides in as a scalar exactly like
+                        # the unfused dispatch (globally-agreed budget_now
+                        # so replicas never fork).
+                        beta = None
+                        if config.prioritized:
+                            frac = min(1.0, budget_now / config.total_env_steps)
+                            beta = config.per_beta + frac * (
+                                config.per_beta_final - config.per_beta
+                            )
+                        with phases.phase("dispatch"):
+                            out = megastep.run_beat(beta=beta)
+                        # NOT the shared after_chunk call below: the beat
+                        # already ran the rollout+insert, and running
+                        # after_chunk twice would double every cadence.
+                        after_chunk(out, None, fused=True)
+                    elif config.prioritized:
                         # beta anneal rides in as a scalar arg. It must be
                         # computed from a globally-identical value
                         # (budget_now — cached global on multi-host), NOT
@@ -1990,10 +2060,11 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                             out = learner.run_sample_chunk_per(
                                 device_replay, beta
                             )
+                        after_chunk(out, None)
                     else:
                         with phases.phase("dispatch"):
                             out = learner.run_sample_chunk(device_replay)
-                    after_chunk(out, None)
+                        after_chunk(out, None)
                 else:
                     with phases.phase("sample_wait"):
                         device_chunk, indices = prefetch.next()
@@ -2118,6 +2189,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # snapshot, so a second call would report zeroed tails.
     serve_final = serve_fields()
     devactor_final = devactor_fields()
+    fused_final = fused_fields()
     log.log(
         "final", env_steps(),
         learner_steps=learn_steps,
@@ -2139,6 +2211,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **guardrail_fields(),
         **serve_final,
         **devactor_final,
+        **fused_final,
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the
@@ -2167,6 +2240,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **guardrail_fields(),
         **serve_final,
         **devactor_final,
+        **fused_final,
+        # Dispatch-gating fact for tests/operators: True = the fused
+        # megastep carried the steady-state loop (docs/FUSED_BEAT.md).
+        "fused_beat_active": megastep is not None,
     }
 
 

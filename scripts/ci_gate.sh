@@ -41,7 +41,12 @@
 #              BENCH_SHARDED_REPLAY=1 bench becomes the baseline — a
 #              candidate whose sharded placement lands MORE bytes per
 #              ingested row than the baseline's is a placement
-#              regression, not noise.
+#              regression, not noise;
+#              plus the higher-is-better fused_steps_per_s throughput
+#              pin (docs/FUSED_BEAT.md), which SKIPs against pre-fused
+#              baselines and arms once a BENCH_FUSED=1 bench becomes
+#              the baseline — the fused megastep regressing toward the
+#              dispatch-per-phase rate is a fusion regression, not noise.
 #              Keys the BASELINE lacks are SKIPped, so old BENCH_r*.json
 #              baselines gate on value alone and the new pins arm
 #              automatically once a newer bench becomes the baseline; a
@@ -71,7 +76,7 @@ while :; do
 done
 candidate="${1:?usage: ci_gate.sh [--lint] [--programs] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).
