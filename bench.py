@@ -37,7 +37,10 @@ rollouts vs the host-pool path at equal env count E, rows/s curve over E
 BENCH_SHARDED_REPLAY=1 adds the sharded vs replicated device-replay A/B
 (measured ingest bytes/row + per-device storage bytes + chunk rate on the
 8 virtual devices — docs/REPLAY_SHARDING.md; BENCH_SHARDED_ROWS overrides
-the ingest volume); BENCH_FUSED=1 adds the fused-megastep vs
+the ingest volume); BENCH_TP=1 adds the tensor-parallel vs replicated
+learner A/B at widened hidden dims (per-device param+opt bytes /
+model_axis, the docs/MESH.md headline; BENCH_TP_HIDDEN / BENCH_TP_AXES
+override the width and the model-axis list); BENCH_FUSED=1 adds the fused-megastep vs
 dispatch-per-phase A/B (one jitted beat vs three programs per iteration,
 guarded and unguarded, grad-steps/s + rows/s over E —
 docs/FUSED_BEAT.md; BENCH_FUSED_ENVS overrides the E list. The legacy
@@ -852,6 +855,143 @@ def phase_sharded_replay() -> dict:
     }
 
 
+def phase_tp() -> dict:
+    """Tensor-parallel vs replicated learner A/B (BENCH_TP=1;
+    docs/MESH.md) on the 8 virtual CPU devices at WIDENED hidden dims
+    (BENCH_TP_HIDDEN, default 1024 — the seed's 256-wide MLPs are too
+    small for TP to matter; the wide nets model the distributional value
+    heads / pixel encoders the 2D mesh exists for). Per model_axis in
+    BENCH_TP_AXES (default 1,2):
+
+      tp_param_bytes_per_device  MEASURED TrainState bytes (params +
+                                 targets + both Adam states) resident on
+                                 ONE device — the HBM headline, expected
+                                 ~/model_axis for rule-sharded layers
+                                 (lower-is-better ci_gate key at the
+                                 largest axis)
+      tp_steps_per_s             fused-sampling chunk rate (higher-is-
+                                 better ci_gate key; CPU rates are load-
+                                 noisy — the BYTES ratio is the placement
+                                 fact, the rate key catches collapses)
+
+    plus tp_param_bytes_ratio (replicated device bytes / TP device
+    bytes) and a tp_parity_max_abs_diff pin: the TP arm's end state vs
+    the model_axis=1 oracle after identical chunks (same data axis, same
+    draws — the tests/test_partition.py contract re-measured at width).
+    Global batch is held fixed (scale_batch_with_data=False) so both
+    arms do identical algorithmic work."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distributed_ddpg_tpu.config import DDPGConfig
+    from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+    from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+    from distributed_ddpg_tpu.replay.device import DeviceReplay
+    from distributed_ddpg_tpu.types import pack_batch_np
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "2"))
+    hidden = int(os.environ.get("BENCH_TP_HIDDEN", "1024"))
+    axes = [
+        int(x) for x in os.environ.get("BENCH_TP_AXES", "1,2").split(",")
+        if x
+    ]
+    batch = int(os.environ.get("BENCH_TP_BATCH", "64"))
+    chunk = int(os.environ.get("BENCH_TP_CHUNK", "8"))
+    # Fixed data axis = the smallest the axis list allows, so every arm
+    # draws the identical sample stream (the placement-invariant PRNG,
+    # parallel/mesh.py) and end states are comparable.
+    n_dev = len(jax.devices())
+    data_axis = n_dev // max(axes)
+    rng = np.random.default_rng(0)
+    rows = pack_batch_np({
+        "obs": rng.standard_normal((4096, OBS_DIM)).astype(np.float32),
+        "action": rng.uniform(-1, 1, (4096, ACT_DIM)).astype(np.float32),
+        "reward": rng.standard_normal(4096).astype(np.float32),
+        "discount": np.full(4096, 0.99, np.float32),
+        "next_obs": rng.standard_normal((4096, OBS_DIM)).astype(np.float32),
+        "weight": np.ones(4096, np.float32),
+    })
+
+    def device_bytes(state) -> int:
+        dev = jax.devices()[0]
+        total = 0
+        for leaf in jax.tree.leaves(state):
+            for s in leaf.addressable_shards:
+                if s.device == dev:
+                    total += s.data.nbytes
+        return total
+
+    curve = {}
+    states = {}
+    for m in axes:
+        cfg = DDPGConfig(
+            actor_hidden=(hidden, hidden), critic_hidden=(hidden, hidden),
+            batch_size=batch, model_axis=m, fused_chunk="off",
+            scale_batch_with_data=False, replay_capacity=8192,
+        )
+        mesh = mesh_lib.make_mesh(
+            data_axis, m, devices=jax.devices()[: data_axis * m]
+        )
+        lrn = ShardedLearner(
+            cfg, OBS_DIM, ACT_DIM, action_scale=1.0, mesh=mesh,
+            chunk_size=chunk,
+        )
+        replay = DeviceReplay(
+            8192, OBS_DIM, ACT_DIM, mesh=mesh, block_size=1024,
+            async_ship=False,
+        )
+        replay.add_packed(rows)
+        replay.drain_pending()
+        lrn.run_sample_chunk(replay)  # compile + 1 parity chunk
+        out = lrn.run_sample_chunk(replay)  # parity chunk 2
+        jax.block_until_ready(out.td_errors)
+        states[m] = jax.device_get(lrn.state)
+        t0 = time.perf_counter()
+        steps = 0
+        while time.perf_counter() - t0 < seconds:
+            out = lrn.run_sample_chunk(replay)
+            steps += chunk
+        jax.block_until_ready(out.td_errors)
+        rate = steps / (time.perf_counter() - t0)
+        curve[str(m)] = {
+            "tp_param_bytes_per_device": device_bytes(lrn.state),
+            "tp_steps_per_s": round(rate, 1),
+        }
+        replay.close()
+    head = max(axes)
+    tp_bytes = curve[str(head)]["tp_param_bytes_per_device"]
+    result = {
+        "tp": {**curve, "hidden": hidden, "data_axis": data_axis,
+               "n_devices": n_dev},
+        # Top-level gate keys (scripts/ci_gate.sh): per-device state
+        # bytes at the largest TP degree (lower-is-better) and its chunk
+        # rate (higher-is-better).
+        "tp_param_bytes_per_device": tp_bytes,
+        "tp_steps_per_s": curve[str(head)]["tp_steps_per_s"],
+    }
+    if "1" in curve and head != 1:
+        # The replicated/TP ratio and the oracle parity exist ONLY when
+        # the model_axis=1 arm actually ran (BENCH_TP_AXES includes 1):
+        # a fallback denominator would report ratio 1.0 — 'TP buys
+        # nothing' — and an unmeasured parity would read as bit-exact.
+        result["tp_param_bytes_ratio"] = round(
+            curve["1"]["tp_param_bytes_per_device"] / max(tp_bytes, 1), 2
+        )
+    if 1 in states and head != 1:
+        # Present ONLY when the model_axis=1 oracle arm actually ran
+        # (BENCH_TP_AXES includes 1): an unmeasured parity must be
+        # absent, not a 0.0 that reads as bit-exact.
+        result["tp_parity_max_abs_diff"] = max(
+            float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            for a, b in zip(
+                jax.tree.leaves(states[1]), jax.tree.leaves(states[head])
+            )
+        )
+    return result
+
+
 def phase_fused() -> dict:
     """Fused-megastep vs dispatch-per-phase A/B (BENCH_FUSED=1;
     docs/FUSED_BEAT.md): grad-steps/s and rollout rows/s at equal E and
@@ -1027,6 +1167,7 @@ _PHASES = {
     "devactor": phase_devactor,
     "sharded_replay": phase_sharded_replay,
     "fused": phase_fused,
+    "tp": phase_tp,
 }
 
 
@@ -1377,6 +1518,26 @@ def main() -> int:
         )
         if shard_res:
             result.update(shard_res)
+        else:
+            errors.append(err)
+
+    # Tensor-parallel A/B (BENCH_TP=1; docs/MESH.md): CPU-only on the 8
+    # virtual devices, tunnel-independent. The top-level
+    # tp_param_bytes_per_device / tp_steps_per_s keys arm ci_gate.sh's
+    # TP pins once this bench becomes the baseline.
+    if os.environ.get("BENCH_TP", "0") == "1" and not study_only:
+        note("tensor-parallel bench phase")
+        tp_res, err = _run_phase(
+            "tp",
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count=8").strip(),
+            },
+            timeout=600,
+        )
+        if tp_res:
+            result.update(tp_res)
         else:
             errors.append(err)
 

@@ -477,16 +477,36 @@ def program_specs():
         probe_mesh,
     )
 
-    def build():
-        config = probe_config(device_actor_envs=4, device_actor_chunk=2)
-        pool = DeviceActorPool(config, mesh=probe_mesh())
-        from distributed_ddpg_tpu.learner import init_train_state
+    def build(tp: bool = False):
+        def _build():
+            config = probe_config(
+                device_actor_envs=4, device_actor_chunk=2,
+                model_axis=2 if tp else 1,
+            )
+            mesh = probe_mesh(2 if tp else 1)
+            pool = DeviceActorPool(config, mesh=mesh)
+            from distributed_ddpg_tpu.learner import init_train_state
+            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
 
-        params = init_train_state(
-            config, pool.env.obs_dim, pool.env.act_dim, config.seed
-        ).actor_params
-        return BuiltProgram(pool._rollout, (params, pool._carry), (1,))
+            params = init_train_state(
+                config, pool.env.obs_dim, pool.env.act_dim, config.seed
+            ).actor_params
+            if tp:
+                # The live tree's placement: TP-sharded kernels per the
+                # rule table, exactly what the pointer-swap refresh hands
+                # the rollout (docs/MESH.md).
+                params = jax.device_put(
+                    params,
+                    mesh_lib.to_named(
+                        mesh, mesh_lib.net_pspec(params, mesh.shape["model"])
+                    ),
+                )
+            return BuiltProgram(pool._rollout, (params, pool._carry), (1,))
+        return _build
 
     return [
-        ProgramSpec("devactor.rollout", "actors/device_pool.py", build),
+        ProgramSpec("devactor.rollout", "actors/device_pool.py", build()),
+        ProgramSpec(
+            "devactor.rollout.tp", "actors/device_pool.py", build(tp=True)
+        ),
     ]

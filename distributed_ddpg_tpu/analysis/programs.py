@@ -152,22 +152,26 @@ class ProgramReport:
 # ---------------------------------------------------------------------------
 
 
-def probe_mesh():
-    """The tiny CPU mesh every spec builds under: (data=2, model=1). The
-    CLI forces a multi-device CPU platform before importing jax
+def probe_mesh(model_axis: int = 1):
+    """The tiny CPU mesh every spec builds under: (data=2, model=1) by
+    default; model_axis=2 gives the (data=2, model=2) TP probe mesh the
+    `.tp` spec variants build under (docs/MESH.md — a collective reorder
+    under the 2D mesh must be a reviewed golden diff, not a pod fork).
+    The CLI forces a multi-device CPU platform before importing jax
     (tools/proganalyze.py); under pytest, tests/conftest.py already did."""
     from distributed_ddpg_tpu.parallel import mesh as mesh_lib
 
+    need = PROBE_MESH_DEVICES * model_axis
     devices = jax.devices("cpu")
-    if len(devices) < PROBE_MESH_DEVICES:
+    if len(devices) < need:
         raise ProgramBuildError(
-            f"program specs need >= {PROBE_MESH_DEVICES} CPU devices for "
+            f"program specs need >= {need} CPU devices for "
             "the probe mesh; run under XLA_FLAGS="
             "--xla_force_host_platform_device_count=8 (the proganalyze "
             "CLI sets this itself)"
         )
     return mesh_lib.make_mesh(
-        PROBE_MESH_DEVICES, 1, devices=devices[:PROBE_MESH_DEVICES]
+        PROBE_MESH_DEVICES, model_axis, devices=devices[:need]
     )
 
 

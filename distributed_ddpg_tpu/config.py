@@ -122,9 +122,10 @@ class DDPGConfig:
     # identical indices and reassembles the minibatch with an owner-masked
     # gather + psum inside the jitted chunk; sampled minibatches are
     # bit-identical to replicated mode. Forces the XLA scan path (the
-    # megakernel reads replicated storage whole) and requires
-    # model_axis=1; multi-host sharded runs omit replay contents from
-    # checkpoints (no single-writer snapshot spans the shards).
+    # megakernel reads replicated storage whole) and composes with
+    # model_axis > 1 (ring on 'data' x params on 'model' — docs/MESH.md);
+    # multi-host sharded runs omit replay contents from checkpoints (no
+    # single-writer snapshot spans the shards).
     replay_sharding: str = "replicated"
     # Device-replay ingest pipeline (replay/device.py; docs/INGEST.md).
     # ingest_async moves single-process host->HBM shipping onto a
@@ -268,7 +269,12 @@ class DDPGConfig:
     # (ondevice.py); num_actors then means on-device vector envs.
     backend: str = "jax_tpu"
     data_axis: int = -1              # -1: all devices on data axis
-    model_axis: int = 1              # tensor-parallel degree over hidden dims
+    # Tensor-parallel degree over hidden dims (the mesh's 'model' axis).
+    # Params + Adam moments shard per the regex rule tables in
+    # parallel/partition.py (per-device param+opt HBM / model_axis);
+    # composes with sharded replay, device actors, the serve jax backend,
+    # and the fused megastep — see docs/MESH.md for the decision table.
+    model_axis: int = 1
     # Data-parallel batch semantics for the device-sampling learner paths:
     # True (default) = batch_size is PER-DEVICE — each data-axis device
     # draws its own batch_size rows and the global batch grows with the
@@ -603,12 +609,6 @@ class DDPGConfig:
                     "whole) — incompatible with fused_chunk='on'; use "
                     "'auto' (degrades to scan) or 'off'"
                 )
-            if self.model_axis != 1:
-                raise ValueError(
-                    "replay_sharding='sharded' shards over the 'data' "
-                    "axis only; model_axis must be 1 (TP composition is a "
-                    "ROADMAP follow-on)"
-                )
             if self.data_axis > 0:
                 # Mesh-dependent alignment checks run again at replay
                 # construction with the ACTUAL device count; with an
@@ -638,6 +638,45 @@ class DDPGConfig:
                             "count (keeps the ring pointer shard-aligned)."
                             " Adjust device_actor_envs/device_actor_chunk"
                         )
+        # --- tensor parallelism (model_axis > 1; parallel/partition.py,
+        # docs/MESH.md). The composition matrix: TP is LEGAL with sharded
+        # replay (ring on 'data' x params on 'model'), device actors, the
+        # serve jax backend, and the fused megastep; the genuine
+        # rejections below each name the knob to flip. ---
+        if self.model_axis < 1:
+            raise ValueError(
+                f"model_axis must be >= 1, got {self.model_axis} (1 = "
+                "data-parallel only)"
+            )
+        if self.model_axis > 1:
+            if self.backend == "native":
+                raise ValueError(
+                    "model_axis > 1 shards params over a jax mesh; the "
+                    "native numpy backend has no mesh — use "
+                    "backend='jax_tpu' (or 'jax_ondevice'), or set "
+                    "model_axis=1"
+                )
+            if self.fused_chunk == "on":
+                raise ValueError(
+                    "model_axis > 1 shards the param tensors the Pallas "
+                    "megakernel needs VMEM-whole — incompatible with "
+                    "fused_chunk='on'; use fused_chunk='auto' (degrades "
+                    "to the XLA scan path) or 'off', or set model_axis=1"
+                )
+            for knob in ("actor_hidden", "critic_hidden"):
+                bad = [
+                    d for d in getattr(self, knob)
+                    if d % self.model_axis != 0
+                ]
+                if bad:
+                    raise ValueError(
+                        f"model_axis={self.model_axis} cannot shard "
+                        f"{knob}={tuple(getattr(self, knob))}: hidden "
+                        f"dim(s) {bad} do not divide the model axis, so "
+                        "every layer would silently replicate and TP "
+                        f"would buy nothing — pick {knob} dims divisible "
+                        f"by {self.model_axis}, or lower model_axis"
+                    )
         if self.policy_delay < 1:
             raise ValueError("policy_delay must be >= 1")
         if self.target_noise < 0 or self.target_noise_clip < 0:

@@ -354,6 +354,56 @@ class ReplayShardStats:
         return out
 
 
+class MeshStats:
+    """Placement facts for the (data, model) mesh (parallel/mesh.py +
+    parallel/partition.py; docs/MESH.md) — the `mesh_*` family every
+    train/final JSONL record carries on the jax_tpu path. All gauges,
+    recomputed at log cadence from leaf SHARDING METADATA only (shapes x
+    shard shapes — zero d2h, zero device work):
+
+      mesh_data_axis               the mesh's data-parallel degree
+      mesh_model_axis              the mesh's tensor-parallel degree
+      mesh_param_bytes_per_device  TrainState bytes (params + targets +
+                                   both Adam states) resident on ONE
+                                   device — the /model_axis HBM headline
+                                   the rule tables buy (docs/MESH.md)
+      mesh_param_bytes_total       logical (unsharded) TrainState bytes,
+                                   the per-device value's denominator
+
+    No lock: the fields derive from immutable mesh shape + per-leaf
+    metadata reads, and only the learner thread snapshots them."""
+
+    def __init__(self, data_axis: int, model_axis: int):
+        self._data = int(data_axis)
+        self._model = int(model_axis)
+
+    def snapshot(self, state_leaves) -> Dict[str, float]:
+        per_device = 0
+        total = 0
+        for leaf in state_leaves:
+            shape = tuple(getattr(leaf, "shape", ()))
+            itemsize = int(getattr(getattr(leaf, "dtype", None),
+                                   "itemsize", 4))
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * itemsize
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and hasattr(sharding, "shard_shape"):
+                m = 1
+                for d in sharding.shard_shape(shape):
+                    m *= int(d)
+                per_device += m * itemsize
+            else:
+                per_device += n * itemsize
+        return {
+            "mesh_data_axis": self._data,
+            "mesh_model_axis": self._model,
+            "mesh_param_bytes_per_device": per_device,
+            "mesh_param_bytes_total": total,
+        }
+
+
 class DevActorStats:
     """Counters for the device-actor subsystem (actors/device_pool.py;
     docs/DEVICE_ACTORS.md) — the `devactor_*` family every train/final

@@ -1193,15 +1193,17 @@ def program_specs():
     OWNER = "parallel/learner.py"
     cache: Dict[tuple, ShardedLearner] = {}
 
-    def learner(guard: bool = False, sharded: bool = False) -> ShardedLearner:
-        key = (guard, sharded)
+    def learner(
+        guard: bool = False, sharded: bool = False, tp: bool = False
+    ) -> ShardedLearner:
+        key = (guard, sharded, tp)
         if key not in cache:
             cache[key] = ShardedLearner(
-                probe_config(guardrails=guard),
+                probe_config(guardrails=guard, model_axis=2 if tp else 1),
                 obs_dim=3,
                 act_dim=1,
                 action_scale=np.ones(1, np.float32),
-                mesh=probe_mesh(),
+                mesh=probe_mesh(2 if tp else 1),
                 chunk_size=2,
                 replay_sharding="sharded" if sharded else "replicated",
             )
@@ -1230,9 +1232,9 @@ def program_specs():
             return BuiltProgram(L._chunk_step, (L.state, chunk), (0,))
         return build
 
-    def uniform(guard: bool, sharded: bool):
+    def uniform(guard: bool, sharded: bool, tp: bool = False):
         def build():
-            L = learner(guard=guard, sharded=sharded)
+            L = learner(guard=guard, sharded=sharded, tp=tp)
             storage, size = storage_for(L)
             if guard:
                 return BuiltProgram(
@@ -1246,9 +1248,9 @@ def program_specs():
             )
         return build
 
-    def per(guard: bool, sharded: bool):
+    def per(guard: bool, sharded: bool, tp: bool = False):
         def build():
-            L = learner(guard=guard, sharded=sharded)
+            L = learner(guard=guard, sharded=sharded, tp=tp)
             storage, size = storage_for(L)
             prios = jax.device_put(
                 np.zeros(64, np.float32),
@@ -1300,4 +1302,23 @@ def program_specs():
                 beat_group="learner-beat-per-sharded",
             ),
         ])
+    # TP variants (docs/MESH.md): the same sharded sampling chunks under
+    # the (data=2, model=2) probe mesh — the 'data'-axis gather/psum
+    # exchange must stay collective-order-stable when params shard on
+    # 'model' (the SPMD partitioner's own collectives are downstream of
+    # this jaxpr and follow it deterministically). They SHARE the 1D
+    # sharded variants' beat_group so the cross-variant order equality
+    # is enforced by the group check, not just per-program goldens.
+    specs.extend([
+        ProgramSpec(
+            "learner.chunk.uniform.sharded.tp", OWNER,
+            uniform(False, sharded=True, tp=True),
+            beat_group="learner-beat-uniform-sharded",
+        ),
+        ProgramSpec(
+            "learner.chunk.per.sharded.tp", OWNER,
+            per(False, sharded=True, tp=True),
+            beat_group="learner-beat-per-sharded",
+        ),
+    ])
     return specs

@@ -323,8 +323,10 @@ def program_specs():
     OWNER = "parallel/megastep.py"
     cache = {}
 
-    def megastep(guard: bool, per: bool, sharded: bool) -> FusedMegastep:
-        key = (guard, per, sharded)
+    def megastep(
+        guard: bool, per: bool, sharded: bool, tp: bool = False
+    ) -> FusedMegastep:
+        key = (guard, per, sharded, tp)
         if key not in cache:
             placement = "sharded" if sharded else "replicated"
             config = probe_config(
@@ -337,8 +339,9 @@ def program_specs():
                 replay_sharding=placement,
                 fused_chunk="off",
                 fused_beat="on",
+                model_axis=2 if tp else 1,
             )
-            mesh = probe_mesh()
+            mesh = probe_mesh(2 if tp else 1)
             pool = DeviceActorPool(config, mesh=mesh)
             learner = ShardedLearner(
                 config,
@@ -358,9 +361,9 @@ def program_specs():
             cache[key] = FusedMegastep(config, learner, pool, replay)
         return cache[key]
 
-    def build(guard: bool, per: bool, sharded: bool):
+    def build(guard: bool, per: bool, sharded: bool, tp: bool = False):
         def _build():
-            ms = megastep(guard, per, sharded)
+            ms = megastep(guard, per, sharded, tp)
             return BuiltProgram(ms._beat, ms.example_args(), ms._donate)
         return _build
 
@@ -376,4 +379,16 @@ def program_specs():
                     build(guard, per, sharded),
                     beat_group=f"megastep-beat-{kind}{shard_tag}",
                 ))
+        # TP variant (docs/MESH.md): the full fused composition — sharded
+        # ring on 'data' x params on 'model' — under the (2, 2) probe
+        # mesh. It SHARES the 1D sharded beat's beat_group: the
+        # explicitly-staged exchange must match that beat's order (a pod
+        # mixing TP degrees would fork), and the group check enforces the
+        # cross-variant equality a lone golden diff could quietly drop.
+        specs.append(ProgramSpec(
+            f"megastep.beat.{kind}.sharded.tp",
+            OWNER,
+            build(False, per, True, tp=True),
+            beat_group=f"megastep-beat-{kind}.sharded",
+        ))
     return specs

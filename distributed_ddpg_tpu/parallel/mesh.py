@@ -7,11 +7,20 @@ gRPC. Here the topology is a `jax.sharding.Mesh` with two named axes:
 - `data`: the data-parallel axis. Replay minibatches shard their leading
   (batch) dim here; XLA turns the per-shard gradient contributions into one
   AllReduce over ICI (the `psum` the north star names, BASELINE.json:5).
-- `model`: optional tensor parallelism. DDPG's MLPs are far too small to
-  NEED TP (SURVEY.md §2 'Parallelism-strategy inventory' marks it N/A in the
-  reference), but params are plain pytrees so the spec tree below shards
-  hidden dims Megatron-style (alternating column-/row-parallel) when
-  model_axis > 1 — proving the design scales to nets where TP matters.
+  Sharded device replay partitions its HBM ring over this axis too
+  (docs/REPLAY_SHARDING.md).
+- `model`: tensor parallelism. Params shard over this axis according to
+  the regex partition-rule tables in `parallel/partition.py` (Megatron
+  column-/row-parallel alternation by default; per-net tables for
+  anything else — docs/MESH.md has the grammar and the add-a-rule
+  recipe). model_axis > 1 composes with sharded replay, device actors,
+  the serve jax backend, and the fused megastep: per-device param +
+  optimizer HBM divides by the model-axis size.
+
+This module owns the MESH (make_mesh, shard_map, to_named) and the
+batch-side specs; the param-side spec construction (net_pspec,
+state_pspec) lives in partition.py and is re-exported here so existing
+callers keep their import path.
 
 Multi-host (DCN) uses the SAME mesh/specs: jax.distributed.initialize makes
 jax.devices() span hosts, and XLA routes the collective hierarchically
@@ -26,7 +35,31 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_ddpg_tpu.types import Batch, OptState, TrainState
+from distributed_ddpg_tpu.parallel.partition import (  # noqa: F401 (re-export)
+    PartitionRuleError,
+    match_partition_rules,
+    mlp_rules,
+    net_pspec,
+    state_pspec,
+)
+from distributed_ddpg_tpu.types import Batch
+
+# Placement-invariant PRNG (the future jax default): with the legacy
+# non-partitionable threefry, the VALUES jax.random produces inside a
+# jitted program depend on the mesh's model-axis size — measured: the
+# same key draws different normals under (4, 1) vs (4, 2) meshes — which
+# would make every sampled minibatch and OU-noise stream a function of
+# the TP degree and break the model_axis parity oracle
+# (tests/test_partition.py). Set at import of THIS module — every
+# device-program owner imports it before building programs, so all
+# programs in a process trace under one consistent scheme regardless of
+# which entry point (train/bench/proganalyze/multihost child) started
+# it. An explicit JAX_THREEFRY_PARTITIONABLE in the environment wins:
+# that is the embedder's escape hatch back to the legacy scheme.
+import os as _os
+
+if _os.environ.get("JAX_THREEFRY_PARTITIONABLE", "") == "":
+    jax.config.update("jax_threefry_partitionable", True)
 
 
 def shard_map(f, mesh: Mesh, in_specs, out_specs, check: bool = False):
@@ -65,63 +98,6 @@ def make_mesh(
         )
     arr = np.asarray(devices).reshape(data_axis, model_axis)
     return Mesh(arr, ("data", "model"))
-
-
-def _layer_pspec(layer_index: int, num_layers: int, kernel_shape, model_size: int):
-    """Megatron-style alternation: even layers column-parallel (shard the
-    output dim), odd layers row-parallel (shard the input dim). The final
-    layer stays replicated (its output dim is act_dim / 1 / num_atoms —
-    tiny and indivisible). Dims that don't divide the model axis stay
-    replicated rather than erroring — XLA would pad, we'd rather not."""
-    if len(kernel_shape) == 3:
-        # Ensemble-stacked critic (TD3 twin, learner.init_train_state):
-        # leading [2] axis replicated, TP alternation applied to the inner
-        # (in, out) dims exactly as for a plain critic.
-        inner = _layer_pspec(layer_index, num_layers, kernel_shape[1:], model_size)
-        return {"w": P(None, *inner["w"]), "b": P(None, *inner["b"])}
-    in_dim, out_dim = kernel_shape
-    if model_size == 1 or layer_index == num_layers - 1:
-        return {"w": P(None, None), "b": P(None)}
-    if layer_index % 2 == 0:
-        if out_dim % model_size == 0:
-            return {"w": P(None, "model"), "b": P("model")}
-    else:
-        if in_dim % model_size == 0:
-            return {"w": P("model", None), "b": P(None)}
-    return {"w": P(None, None), "b": P(None)}
-
-
-def net_pspec(params, model_size: int):
-    n = len(params)
-    return tuple(
-        _layer_pspec(i, n, params[i]["w"].shape, model_size) for i in range(n)
-    )
-
-
-def state_pspec(state: TrainState, mesh: Mesh) -> TrainState:
-    """PartitionSpec tree mirroring TrainState 1:1. Params (and their Adam
-    moments, which must shard identically) follow net_pspec; scalars
-    replicate."""
-    m = mesh.shape["model"]
-    actor = net_pspec(state.actor_params, m)
-    critic = net_pspec(state.critic_params, m)
-    return TrainState(
-        actor_params=actor,
-        critic_params=critic,
-        target_actor_params=actor,
-        target_critic_params=critic,
-        actor_opt=OptState(mu=actor, nu=actor, count=P()),
-        critic_opt=OptState(mu=critic, nu=critic, count=P()),
-        step=P(),
-        # SAC temperature scalars replicate; None (non-SAC) is an empty
-        # pytree node and needs no spec.
-        log_alpha=None if state.log_alpha is None else P(),
-        alpha_opt=(
-            None
-            if state.alpha_opt is None
-            else OptState(mu=P(), nu=P(), count=P())
-        ),
-    )
 
 
 def batch_pspec() -> Batch:

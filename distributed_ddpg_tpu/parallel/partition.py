@@ -1,0 +1,203 @@
+"""Regex partition-rule engine: param-tree paths -> PartitionSpecs
+(ROADMAP '2D (data, model) named mesh with regex partition rules';
+SNIPPETS.md [2] `match_partition_rules`, [3] DreamZero's ('data','model')
+rule tables).
+
+The old `mesh._layer_pspec` hardcoded one network shape: an MLP whose
+layers alternate Megatron column-/row-parallel by index parity. That
+worked for the seed's two MLPs and nothing else — a pixel encoder's conv
+kernels, a distributional critic's wide value head, or any future net
+would each need another bespoke if-ladder. This module replaces it with
+the idiom large-model JAX codebases converged on: an ORDERED rule table
+mapping regex patterns over '/'-joined tree paths to PartitionSpecs,
+first match wins.
+
+Semantics (each one a contract tests/test_partition.py pins):
+
+- **paths** — a leaf's path is its pytree key path '/'-joined: the actor
+  tuple's layer-2 kernel is `2/w`. Rules are matched with `re.search`,
+  so tables may anchor (`^...$`) or float.
+- **first match wins** — the table is ordered; put specific overrides
+  (the final-layer replication rule) ahead of generic parity rules.
+- **rank alignment** — a spec shorter than the leaf's rank aligns to the
+  TRAILING dims and the extra leading dims replicate. This is what makes
+  one rule cover both a plain critic kernel `[in, out]` and the TD3
+  twin-ensemble kernel `[2, in, out]` (learner.init_train_state stacks
+  the pair on a leading axis).
+- **indivisible -> replicated** — a leaf whose 'model'-sharded dim does
+  not divide the model-axis size replicates instead of erroring (XLA
+  would pad; we'd rather not). This is a per-leaf decision and exactly
+  reproduces the old per-layer fallback: the seed critic's
+  action-insert layer (in_dim = hidden + act_dim, usually odd) stays
+  replicated while its neighbors shard.
+- **scalars replicate** — rank-0 leaves get P() without consulting the
+  table (the SNIPPETS.md [2] rule).
+- **unmatched -> hard error** — a path no rule covers raises
+  PartitionRuleError naming the path. A silently-replicated new layer
+  is exactly the drift this engine exists to prevent: add a rule, on
+  purpose, in review.
+
+The default tables reproduce the old alternation bit-for-bit
+(tests/test_partition.py pins the equality at the seed shapes):
+even-index layers column-parallel (shard the output dim), odd-index
+row-parallel (shard the input dim), final layer replicated (its output
+dim is act_dim / 1 / num_atoms — tiny and indivisible). Even/odd is a
+plain regex fact of decimal strings (last digit [02468] / [13579]); only
+the final-layer override depends on the net's depth, so `mlp_rules(n)`
+prepends it per net.
+
+`state_pspec` derives the Adam-moment specs from the SAME tables the
+params use — params and optimizer state can never shard differently,
+which is the invariant that makes checkpoint restore and the
+pointer-swap param refresh placement-oblivious.
+
+Add-a-rule recipe and the data x model composition decision table:
+docs/MESH.md.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_ddpg_tpu.types import OptState, TrainState
+
+# One rule: (regex over the '/'-joined tree path, PartitionSpec). The
+# spec names mesh axes ('model' here; 'data' stays a batch-dim axis and
+# never appears in param tables).
+Rule = Tuple[str, P]
+
+
+class PartitionRuleError(ValueError):
+    """A param-tree path matched no rule in the table. Every leaf must be
+    placed ON PURPOSE — extend the table (docs/MESH.md 'add a rule')
+    rather than letting a new layer silently replicate."""
+
+
+# Megatron alternation for a {w, b} MLP layer list, index-parity encoded
+# as a regex over the layer index's last decimal digit. Final-layer
+# replication is depth-dependent and prepended by mlp_rules().
+DEFAULT_MLP_RULES: Tuple[Rule, ...] = (
+    # even layers: column-parallel (shard the output dim; bias shards too)
+    (r"(^|/)\d*[02468]/w$", P(None, "model")),
+    (r"(^|/)\d*[02468]/b$", P("model")),
+    # odd layers: row-parallel (shard the input dim; bias replicated —
+    # it adds after the partial-sum reduction)
+    (r"(^|/)\d*[13579]/w$", P("model", None)),
+    (r"(^|/)\d*[13579]/b$", P(None)),
+)
+
+
+def mlp_rules(num_layers: int) -> Tuple[Rule, ...]:
+    """The default table for an MLP of `num_layers` {w, b} layers: the
+    final layer replicates (override first), everything else follows the
+    parity alternation."""
+    last = num_layers - 1
+    return (
+        (rf"(^|/){last}/w$", P(None, None)),
+        (rf"(^|/){last}/b$", P(None)),
+    ) + DEFAULT_MLP_RULES
+
+
+def _path_str(path) -> str:
+    """'/'-joined pytree key path: SequenceKey(2)/DictKey('w') -> '2/w'."""
+    parts = []
+    for k in path:
+        if hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _fit(spec: P, shape: Tuple[int, ...], model_size: int) -> P:
+    """Align `spec` to a leaf of `shape` under a model axis of
+    `model_size`: trailing-dim alignment (extra leading dims replicate),
+    whole-leaf replication when model_size == 1 or when any sharded dim
+    does not divide it (module docstring 'indivisible -> replicated')."""
+    if len(spec) > len(shape):
+        raise PartitionRuleError(
+            f"rule spec {spec} has rank {len(spec)} but the leaf has "
+            f"shape {shape} — a spec must not outrank its leaf"
+        )
+    full = (None,) * (len(shape) - len(spec)) + tuple(spec)
+    replicated = P(*(None,) * len(shape))
+    if model_size == 1:
+        return replicated
+    for dim, ax in zip(shape, full):
+        if ax is not None and dim % model_size != 0:
+            return replicated
+    return P(*full)
+
+
+def match_partition_rules(rules: Sequence[Rule], tree, model_size: int):
+    """PartitionSpec tree for `tree` under the ordered rule table
+    (SNIPPETS.md [2]): scalars replicate, the first matching rule's spec
+    is rank-aligned and divisibility-gated by _fit, and an unmatched
+    path is a hard PartitionRuleError."""
+
+    def place(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0:
+            return P()
+        name = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return _fit(spec, shape, model_size)
+        raise PartitionRuleError(
+            f"no partition rule matches param path {name!r} (shape "
+            f"{shape}) — extend the rule table (docs/MESH.md 'add a "
+            "rule'); every leaf must be placed on purpose"
+        )
+
+    return jax.tree_util.tree_map_with_path(place, tree)
+
+
+def net_pspec(params, model_size: int, rules: Optional[Sequence[Rule]] = None):
+    """Spec tree for one {w, b}-layer param list. Default rules are the
+    per-depth MLP table (mlp_rules); pass `rules` for non-MLP nets."""
+    return match_partition_rules(
+        mlp_rules(len(params)) if rules is None else rules,
+        params,
+        model_size,
+    )
+
+
+def state_pspec(
+    state: TrainState,
+    mesh: Mesh,
+    actor_rules: Optional[Sequence[Rule]] = None,
+    critic_rules: Optional[Sequence[Rule]] = None,
+) -> TrainState:
+    """PartitionSpec tree mirroring TrainState 1:1. Actor/critic params,
+    their targets, AND their Adam moments all derive from the same rule
+    table per net — params and optimizer state can never shard
+    differently. Scalars (step, SAC temperature machinery, Adam counts)
+    replicate."""
+    m = mesh.shape["model"]
+    actor = net_pspec(state.actor_params, m, rules=actor_rules)
+    critic = net_pspec(state.critic_params, m, rules=critic_rules)
+    return TrainState(
+        actor_params=actor,
+        critic_params=critic,
+        target_actor_params=actor,
+        target_critic_params=critic,
+        actor_opt=OptState(mu=actor, nu=actor, count=P()),
+        critic_opt=OptState(mu=critic, nu=critic, count=P()),
+        step=P(),
+        # SAC temperature scalars replicate; None (non-SAC) is an empty
+        # pytree node and needs no spec.
+        log_alpha=None if state.log_alpha is None else P(),
+        alpha_opt=(
+            None
+            if state.alpha_opt is None
+            else OptState(mu=P(), nu=P(), count=P())
+        ),
+    )

@@ -191,12 +191,12 @@ class DeviceReplay:
                     "replay_sharding='sharded' partitions storage over a "
                     "mesh; construct the replay with one"
                 )
-            if mesh.shape["model"] != 1:
-                raise ValueError(
-                    "replay_sharding='sharded' shards over the 'data' axis "
-                    "only; model_axis must be 1 (TP composition is a "
-                    "ROADMAP follow-on)"
-                )
+            # 2D composition (docs/MESH.md): the ring partitions over the
+            # 'data' axis only — under model_axis > 1 every storage spec
+            # below names just 'data', so each shard's rows replicate
+            # across the 'model' axis (per-device HBM is capacity /
+            # data_axis) and the shard_map insert/gather bodies run
+            # identically on every model replica.
             self._n_shards = int(mesh.shape["data"])
             if self.capacity % self._n_shards:
                 raise ValueError(

@@ -68,10 +68,24 @@ class InferenceServer:
         seed: int = 0,
         fault_batcher=None,
         fault_dispatch=None,
+        mesh=None,
     ):
         if backend not in ("numpy", "jax"):
             raise ValueError(f"serve backend must be 'numpy' or 'jax', got {backend!r}")
+        if mesh is not None and backend != "jax":
+            raise ValueError(
+                "mesh= shards the jitted serve apply; the numpy backend "
+                "is the single-threaded bit-parity oracle — use "
+                "backend='jax' or drop the mesh"
+            )
         self.backend = backend
+        # Optional (data, model) mesh for the jax backend: params shard
+        # over 'model' per the partition rule tables (parallel/
+        # partition.py; docs/MESH.md) — the serve path of the 2D
+        # composition, so a TP learner's policy serves without gathering
+        # the kernels onto one device. Activations stay replicated (the
+        # padded (max_batch, obs) block is tiny next to the kernels).
+        self._mesh = mesh
         self.layout = layout
         self.obs_dim = int(layout[0][0][0])  # first layer w is (obs, hidden)
         self.act_dim = int(layout[-1][0][1])
@@ -182,24 +196,42 @@ class InferenceServer:
 
         from distributed_ddpg_tpu.models.mlp import actor_apply
 
-        self._jax_apply = jax.jit(
-            functools.partial(
-                actor_apply,
-                action_scale=self._policy.scale,
-                action_offset=self._policy.offset,
-            )
+        apply = functools.partial(
+            actor_apply,
+            action_scale=self._policy.scale,
+            action_offset=self._policy.offset,
         )
+        if self._mesh is None:
+            self._jax_apply = jax.jit(apply)
+        else:
+            # TP-sharded apply (docs/MESH.md): params carry their rule-
+            # table shardings (shipped below); actions come back
+            # replicated so the d2h slice is placement-oblivious.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._jax_apply = jax.jit(
+                apply, out_shardings=NamedSharding(self._mesh, P())
+            )
         self._ship_jax_params()
 
     def _ship_jax_params(self) -> None:
         import jax
         import jax.numpy as jnp
 
+        params = tuple(
+            {"w": jnp.asarray(l["w"]), "b": jnp.asarray(l["b"])}
+            for l in self._policy.layers
+        )
+        if self._mesh is None:
+            self._jax_params = jax.device_put(params)
+            return
+        # Same rule table as the learner (parallel/partition.py), so the
+        # served mu(s) shards exactly like the training-time actor.
+        from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+        specs = mesh_lib.net_pspec(params, self._mesh.shape["model"])
         self._jax_params = jax.device_put(
-            tuple(
-                {"w": jnp.asarray(l["w"]), "b": jnp.asarray(l["b"])}
-                for l in self._policy.layers
-            )
+            params, mesh_lib.to_named(self._mesh, specs)
         )
 
     def _compute_jax(self, obs: np.ndarray) -> np.ndarray:
@@ -238,14 +270,29 @@ def program_specs():
         ProgramSpec,
     )
 
-    def build():
-        from distributed_ddpg_tpu.actors.policy import param_layout
+    def build(tp: bool = False):
+        def _build():
+            from distributed_ddpg_tpu.actors.policy import param_layout
 
-        layout = param_layout(3, 1, (16, 16))
-        server = InferenceServer(
-            layout, np.ones(1, np.float32), backend="jax", max_batch=8
-        )
-        obs = np.zeros((8, 3), np.float32)
-        return BuiltProgram(server._jax_apply, (server._jax_params, obs))
+            layout = param_layout(3, 1, (16, 16))
+            mesh = None
+            if tp:
+                from distributed_ddpg_tpu.analysis.programs import probe_mesh
 
-    return [ProgramSpec("serve.apply.jax", "serve/server.py", build)]
+                mesh = probe_mesh(2)
+            server = InferenceServer(
+                layout, np.ones(1, np.float32), backend="jax", max_batch=8,
+                mesh=mesh,
+            )
+            obs = np.zeros((8, 3), np.float32)
+            return BuiltProgram(server._jax_apply, (server._jax_params, obs))
+        return _build
+
+    return [
+        ProgramSpec("serve.apply.jax", "serve/server.py", build()),
+        # TP-sharded apply (docs/MESH.md): still collective-free at the
+        # jaxpr level — the partitioner's kernel-shard exchange follows
+        # the lowering deterministically, and serving must never stage an
+        # EXPLICIT collective (it runs outside the pod's lockstep beats).
+        ProgramSpec("serve.apply.jax.tp", "serve/server.py", build(tp=True)),
+    ]

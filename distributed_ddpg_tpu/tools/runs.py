@@ -335,6 +335,19 @@ def summarize_run(path: str) -> Dict[str, Any]:
             }
     digest["fused"] = fused
 
+    # Mesh/TP-placement digest (metrics.MeshStats; docs/MESH.md): the
+    # mesh shape and the per-device TrainState bytes are gauges — the
+    # last value IS the placement fact.
+    mesh = {}
+    mesh_keys = sorted(
+        {k for r in train + final for k in r if k.startswith("mesh_")}
+    )
+    for key in mesh_keys:
+        vals = _col(train + final, key)
+        if vals:
+            mesh[key] = {"last": vals[-1]}
+    digest["mesh"] = mesh
+
     # Replay-placement digest (replay/device.py ReplayShardStats;
     # docs/REPLAY_SHARDING.md): measured ingest bytes/row, per-device
     # storage bytes, per-shard fill, exchange-dispatch tails.
@@ -449,6 +462,12 @@ def render_summary(digest: Dict[str, Any]) -> str:
                 [k, v["steady"], v["max"], v["last"]]
                 for k, v in digest["fused"].items()
             ],
+        ))
+    if digest.get("mesh"):
+        out.append("\n-- mesh / tensor parallelism (docs/MESH.md)")
+        out.append(render_table(
+            ["field", "value"],
+            [[k, v["last"]] for k, v in digest["mesh"].items()],
         ))
     if digest.get("replay_sharding"):
         out.append("\n-- replay placement (docs/REPLAY_SHARDING.md)")
@@ -579,6 +598,16 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
         add(key, fa.get("steady"), fb.get("steady"),
             lower_better=("_ms" in key or "p95" in key or "p50" in key
                           or key.endswith("_max")))
+    for key in sorted(set(a.get("mesh", {})) | set(b.get("mesh", {}))):
+        if key in ("mesh_data_axis", "mesh_model_axis"):
+            continue  # mesh shape is context, not a metric to delta
+        ma_ = a.get("mesh", {}).get(key, {})
+        mb_ = b.get("mesh", {}).get(key, {})
+        # Both bytes gauges are lower-is-better: per-device is the
+        # placement fact, and an unexplained TOTAL growth (an extra
+        # state copy) is a memory regression, never an improvement.
+        add(key, ma_.get("last"), mb_.get("last"),
+            lower_better=("bytes" in key))
     for key in sorted(
         set(a.get("replay_sharding", {})) | set(b.get("replay_sharding", {}))
     ):

@@ -31,6 +31,7 @@ from distributed_ddpg_tpu.config import DDPGConfig
 from distributed_ddpg_tpu.envs import make, spec_of
 from distributed_ddpg_tpu.metrics import (
     GuardrailStats,
+    MeshStats,
     MetricsLogger,
     PhaseTimers,
     PodStats,
@@ -969,6 +970,18 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             fault_dispatch=(
                 fault_plan.site("serve", "dispatch") if fault_plan else None
             ),
+            # jax backend under TP: serve over the learner's mesh so the
+            # policy kernels stay 'model'-sharded at serve time too
+            # (parallel/partition.py rule tables; docs/MESH.md). Gated on
+            # model_axis > 1 — at 1 the specs are fully replicated and a
+            # mesh-wide serve dispatch would only queue behind learner
+            # chunks on every device for zero HBM benefit; the
+            # single-device apply keeps serving off the training streams.
+            mesh=(
+                learner.mesh
+                if config.serve_backend == "jax" and config.model_axis > 1
+                else None
+            ),
         ).start()
         serve_front = ServeFront(
             serve_server, *pool.serve_channels()
@@ -1140,6 +1153,17 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         the per-beat dispatch tails. Records stay clean on the
         dispatch-per-phase loop."""
         return megastep.snapshot() if megastep is not None else {}
+
+    mesh_stats = MeshStats(
+        learner.mesh.shape["data"], learner.mesh.shape["model"]
+    )
+
+    def mesh_fields() -> Dict[str, float]:
+        """mesh_* placement facts (metrics.MeshStats; docs/MESH.md) for
+        every train/final record: mesh shape plus the measured per-device
+        TrainState bytes — the /model_axis HBM claim as an observation of
+        the live tree's sharding metadata (zero d2h)."""
+        return mesh_stats.snapshot(jax.tree.leaves(learner.state))
 
     def _guard_quarantine_sources() -> None:
         """Bad-row -> ingest-source attribution: fetch the offending
@@ -1716,6 +1740,8 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 **devactor_fields(),
                 # Fused megastep beats (docs/FUSED_BEAT.md).
                 **fused_fields(),
+                # Mesh placement facts (docs/MESH.md).
+                **mesh_fields(),
             )
 
         # Periodic eval (SURVEY.md §2 #1 'periodic eval & checkpoint'):
@@ -2212,6 +2238,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         **serve_final,
         **devactor_final,
         **fused_final,
+        **mesh_fields(),
     )
     log.close()
     # Checksum of the final actor params: lets determinism tests (and the

@@ -46,7 +46,14 @@
 #              pin (docs/FUSED_BEAT.md), which SKIPs against pre-fused
 #              baselines and arms once a BENCH_FUSED=1 bench becomes
 #              the baseline — the fused megastep regressing toward the
-#              dispatch-per-phase rate is a fusion regression, not noise.
+#              dispatch-per-phase rate is a fusion regression, not noise;
+#              plus the tensor-parallel pins (docs/MESH.md): the
+#              lower-is-better tp_param_bytes_per_device placement fact
+#              (a candidate whose TP placement holds MORE state bytes
+#              per device than the baseline's is a rule-table
+#              regression) and the higher-is-better tp_steps_per_s rate,
+#              both of which SKIP against pre-TP baselines and arm once
+#              a BENCH_TP=1 bench becomes the baseline.
 #              Keys the BASELINE lacks are SKIPped, so old BENCH_r*.json
 #              baselines gate on value alone and the new pins arm
 #              automatically once a newer bench becomes the baseline; a
@@ -76,7 +83,7 @@ while :; do
 done
 candidate="${1:?usage: ci_gate.sh [--lint] [--programs] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).

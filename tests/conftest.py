@@ -20,3 +20,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Placement-invariant PRNG — the repo-wide RNG scheme (see the note in
+# parallel/mesh.py): set here too so tests that touch jax.random before
+# importing parallel.mesh trace under the same scheme.
+jax.config.update("jax_threefry_partitionable", True)

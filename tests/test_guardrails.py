@@ -474,6 +474,9 @@ def _records(path):
     return out
 
 
+# Re-tiered to slow (ISSUE 15 tier-1 budget): 81s rollback chaos train run; the healthy-parity + unit battery keep
+# guardrails tier-1 coverage
+@pytest.mark.slow
 def test_numeric_nan_chaos_rolls_back_and_completes(tmp_path):
     """The acceptance run (ISSUE 7): a CPU training run with an injected
     `numeric:grad:nan@k` must complete its env budget, report >= 1

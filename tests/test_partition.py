@@ -1,0 +1,427 @@
+"""The regex partition-rule engine (parallel/partition.py; docs/MESH.md)
+and the 2D ('data','model') mesh composition it unlocks: the rule tables
+must reproduce the legacy hardcoded alternation bit-for-bit at the seed
+shapes, a model_axis=2 run must compose with sharded replay + device
+actors + the serve jax backend + the fused beat and land float-tolerance
+parity against the model_axis=1 oracle, and checkpoints must roundtrip
+across placements bit-identically."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_ddpg_tpu.config import DDPGConfig
+from distributed_ddpg_tpu.learner import init_train_state
+from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+from distributed_ddpg_tpu.parallel import partition
+from distributed_ddpg_tpu.parallel.learner import ShardedLearner
+from distributed_ddpg_tpu.types import pack_batch_np
+
+OBS, ACT = 4, 2
+
+
+# ---------------------------------------------------------------------------
+# the rule engine itself
+# ---------------------------------------------------------------------------
+
+
+def _legacy_layer_pspec(i, n, shape, m):
+    """The pre-engine mesh._layer_pspec, verbatim — the oracle the rule
+    tables must reproduce bit-for-bit (docs/MESH.md 'Rule grammar')."""
+    if len(shape) == 3:
+        inner = _legacy_layer_pspec(i, n, shape[1:], m)
+        return {"w": P(None, *inner["w"]), "b": P(None, *inner["b"])}
+    in_dim, out_dim = shape
+    if m == 1 or i == n - 1:
+        return {"w": P(None, None), "b": P(None)}
+    if i % 2 == 0:
+        if out_dim % m == 0:
+            return {"w": P(None, "model"), "b": P("model")}
+    else:
+        if in_dim % m == 0:
+            return {"w": P("model", None), "b": P(None)}
+    return {"w": P(None, None), "b": P(None)}
+
+
+def _legacy_net_pspec(params, m):
+    n = len(params)
+    return tuple(
+        _legacy_layer_pspec(i, n, params[i]["w"].shape, m) for i in range(n)
+    )
+
+
+@pytest.mark.parametrize(
+    "cfg_kw",
+    [
+        {},  # the seed DDPG shapes
+        dict(twin_critic=True, target_noise=0.1),  # rank-3 ensemble leaves
+        dict(sac=True),  # double-width Gaussian head + alpha machinery
+        dict(distributional=True),  # wide categorical value head
+        dict(actor_hidden=(400, 300), critic_hidden=(400, 300)),
+        dict(actor_hidden=(64, 64, 64), critic_hidden=(64, 64, 64)),
+    ],
+)
+def test_rules_reproduce_legacy_pspec(cfg_kw):
+    """The default tables reproduce the old hardcoded Megatron
+    alternation EXACTLY — same specs, same arity, same indivisible
+    fallbacks — at every model size, for every algorithm family."""
+    cfg = DDPGConfig(**cfg_kw)
+    state = init_train_state(cfg, 3, 1, 0)
+    for m in (1, 2, 4, 8):
+        for net in ("actor_params", "critic_params"):
+            params = getattr(state, net)
+            assert partition.net_pspec(params, m) == _legacy_net_pspec(
+                params, m
+            ), (cfg_kw, m, net)
+
+
+def test_state_pspec_opt_moments_match_params():
+    """Adam moments derive from the SAME table as the params — they can
+    never shard differently (the checkpoint/pointer-swap invariant)."""
+    state = init_train_state(DDPGConfig(), OBS, ACT, 0)
+    mesh = mesh_lib.make_mesh(-1, 2)
+    sp = partition.state_pspec(state, mesh)
+    assert sp.actor_opt.mu == sp.actor_params
+    assert sp.actor_opt.nu == sp.actor_params
+    assert sp.critic_opt.mu == sp.critic_params
+    assert sp.target_actor_params == sp.actor_params
+    assert sp.step == P() and sp.actor_opt.count == P()
+
+
+def test_rule_engine_semantics():
+    leaf = lambda *s: np.zeros(s, np.float32)
+    # first match wins — the specific override beats the generic rule
+    tree = {"head": {"w": leaf(8, 4)}}
+    rules = [
+        (r"head/w$", P(None, None)),
+        (r"/w$", P(None, "model")),
+    ]
+    spec = partition.match_partition_rules(rules, tree, 2)
+    assert spec["head"]["w"] == P(None, None)
+    # rank alignment: a rank-2 spec covers a rank-3 stacked leaf
+    tree = ({"w": leaf(2, 8, 4)},)
+    spec = partition.match_partition_rules([(r"w$", P(None, "model"))], tree, 2)
+    assert spec[0]["w"] == P(None, None, "model")
+    # indivisible -> whole-leaf replication, not an error
+    spec = partition.match_partition_rules([(r"w$", P(None, "model"))],
+                                           ({"w": leaf(8, 5)},), 2)
+    assert spec[0]["w"] == P(None, None)
+    # scalars replicate without consulting the table
+    spec = partition.match_partition_rules([], {"count": leaf()}, 2)
+    assert spec["count"] == P()
+    # unmatched path is a hard error naming the path
+    with pytest.raises(partition.PartitionRuleError, match="0/w"):
+        partition.match_partition_rules([(r"nope", P())], ({"w": leaf(4, 4)},), 2)
+    # a spec outranking its leaf is a table bug, not a silent truncation
+    with pytest.raises(partition.PartitionRuleError, match="outrank"):
+        partition.match_partition_rules(
+            [(r"b$", P(None, "model"))], ({"b": leaf(4)},), 2)
+
+
+# ---------------------------------------------------------------------------
+# config validation matrix (docs/MESH.md decision table)
+# ---------------------------------------------------------------------------
+
+
+def test_config_tp_validation_matrix():
+    # newly legal: TP composes with sharded replay / device actors /
+    # serve jax / fused beat
+    DDPGConfig(model_axis=2, replay_sharding="sharded", fused_chunk="off")
+    DDPGConfig(
+        model_axis=2, replay_sharding="sharded", actor_backend="device",
+        num_actors=0, fused_beat="on", fused_chunk="off",
+    )
+    DDPGConfig(model_axis=2, serve_actors=True, serve_backend="jax")
+    # genuine rejections, each naming the knob to flip
+    with pytest.raises(ValueError, match="model_axis must be >= 1"):
+        DDPGConfig(model_axis=0)
+    with pytest.raises(ValueError, match="backend='jax_tpu'"):
+        DDPGConfig(model_axis=2, backend="native")
+    with pytest.raises(ValueError, match="fused_chunk='auto'"):
+        DDPGConfig(model_axis=2, fused_chunk="on")
+    with pytest.raises(ValueError, match="actor_hidden"):
+        DDPGConfig(model_axis=2, actor_hidden=(255, 256))
+    with pytest.raises(ValueError, match="critic_hidden"):
+        DDPGConfig(model_axis=4, critic_hidden=(256, 130))
+    # explicit shard_map mode stays data-parallel only (learner-level)
+    with pytest.raises(ValueError, match="data-parallel only"):
+        ShardedLearner(
+            DDPGConfig(model_axis=2), OBS, ACT, action_scale=1.0,
+            mode="explicit",
+        )
+
+
+# ---------------------------------------------------------------------------
+# the 2D composition + the model_axis=1 parity oracle
+# ---------------------------------------------------------------------------
+
+
+def _rows(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    return pack_batch_np({
+        "obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "action": rng.uniform(-1, 1, (n, ACT)).astype(np.float32),
+        "reward": rng.standard_normal(n).astype(np.float32),
+        "discount": np.full(n, 0.99, np.float32),
+        "next_obs": rng.standard_normal((n, OBS)).astype(np.float32),
+        "weight": np.ones(n, np.float32),
+    })
+
+
+def _state_diff(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _learner_end_state(model_axis, per=False):
+    from distributed_ddpg_tpu.replay.device import (
+        DevicePrioritizedReplay,
+        DeviceReplay,
+    )
+
+    cfg = DDPGConfig(
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=32,
+        model_axis=model_axis, fused_chunk="off", prioritized=per,
+        scale_batch_with_data=False, replay_sharding="sharded",
+        replay_capacity=4096,
+    )
+    # Fixed data axis (4) across arms: same index/noise streams (the
+    # placement-invariant PRNG note in parallel/mesh.py), so the end
+    # states are float-tolerance comparable.
+    mesh = mesh_lib.make_mesh(4, model_axis,
+                              devices=jax.devices()[: 4 * model_axis])
+    lrn = ShardedLearner(cfg, OBS, ACT, action_scale=1.0, mesh=mesh,
+                         chunk_size=3, replay_sharding="sharded")
+    cls = DevicePrioritizedReplay if per else DeviceReplay
+    rep = cls(4096, OBS, ACT, mesh=mesh, block_size=1024, async_ship=False,
+              replay_sharding="sharded")
+    rep.add_packed(_rows())
+    rep.drain_pending()
+    for _ in range(3):
+        if per:
+            lrn.run_sample_chunk_per(rep, beta=0.5)
+        else:
+            lrn.run_sample_chunk(rep)
+    return jax.device_get(lrn.state), lrn
+
+
+def test_tp_sharded_replay_learner_parity():
+    """model_axis=2 x replay_sharding='sharded': params actually shard
+    on 'model', the ring stays partitioned on 'data', and the learner
+    end state matches the model_axis=1 oracle to float tolerance (same
+    data axis => same sampled index stream)."""
+    ref, _ = _learner_end_state(1)
+    tp, lrn = _learner_end_state(2)
+    assert lrn.state.actor_params[0]["w"].sharding.spec == P(None, "model")
+    assert _state_diff(ref, tp) < 1e-5
+
+
+@pytest.mark.slow
+def test_tp_sharded_replay_per_parity():
+    """Same oracle for the prioritized path: the sharded PER draw's
+    index stream is a function of the DATA axis partition only, so TP
+    changes nothing but matmul reduction order."""
+    ref, _ = _learner_end_state(1, per=True)
+    tp, _ = _learner_end_state(2, per=True)
+    assert _state_diff(ref, tp) < 1e-5
+
+
+def _fused_beat_end_state(model_axis, per=False):
+    from distributed_ddpg_tpu.actors.device_pool import DeviceActorPool
+    from distributed_ddpg_tpu.parallel.megastep import FusedMegastep
+    from distributed_ddpg_tpu.replay.device import (
+        DevicePrioritizedReplay,
+        DeviceReplay,
+    )
+
+    cfg = DDPGConfig(
+        env_id="Pendulum-v1", actor_backend="device", num_actors=0,
+        device_actor_envs=8, device_actor_chunk=2,
+        actor_hidden=(32, 32), critic_hidden=(32, 32), batch_size=16,
+        scale_batch_with_data=False, prioritized=per,
+        model_axis=model_axis, fused_chunk="off", fused_beat="on",
+        replay_sharding="sharded", replay_capacity=4096,
+    )
+    mesh = mesh_lib.make_mesh(4, model_axis,
+                              devices=jax.devices()[: 4 * model_axis])
+    pool = DeviceActorPool(cfg, mesh=mesh)
+    lrn = ShardedLearner(
+        cfg, pool.obs_dim, pool.act_dim, pool.action_scale,
+        action_offset=pool.action_offset, mesh=mesh, chunk_size=2,
+        replay_sharding="sharded",
+    )
+    cls = DevicePrioritizedReplay if per else DeviceReplay
+    rep = cls(4096, pool.obs_dim, pool.act_dim, mesh=mesh, block_size=16,
+              async_ship=False, replay_sharding="sharded")
+    pool.set_params(lrn.state.actor_params)
+    while len(rep) < cfg.batch_size:
+        pool.run_chunk(rep)
+    ms = FusedMegastep(cfg, lrn, pool, rep)
+    for _ in range(3):
+        ms.run_beat(beta=0.5) if per else ms.run_beat()
+    logical = rep._to_logical_rows(np.asarray(jax.device_get(rep.storage)))
+    return jax.device_get(lrn.state), logical
+
+
+def test_tp_fused_beat_full_composition_parity():
+    """The acceptance composition: model_axis=2 x sharded replay x
+    device actors x fused_beat='on' runs as ONE donated-carry beat
+    program on the 8-virtual-device mesh, and both the learner end
+    state AND the ring contents (logical order) match the model_axis=1
+    oracle to float tolerance."""
+    ref_state, ref_ring = _fused_beat_end_state(1)
+    tp_state, tp_ring = _fused_beat_end_state(2)
+    assert _state_diff(ref_state, tp_state) < 1e-5
+    assert float(np.max(np.abs(ref_ring - tp_ring))) < 1e-5
+
+
+@pytest.mark.slow
+def test_tp_fused_beat_per_parity():
+    ref_state, ref_ring = _fused_beat_end_state(1, per=True)
+    tp_state, tp_ring = _fused_beat_end_state(2, per=True)
+    assert _state_diff(ref_state, tp_state) < 1e-5
+    assert float(np.max(np.abs(ref_ring - tp_ring))) < 1e-5
+
+
+def test_serve_jax_tp_matches_oracle():
+    """serve_backend='jax' over a TP mesh: kernels genuinely shard on
+    'model' (same rule table as the learner) and served actions match
+    both the single-device jax apply and the numpy bit-parity oracle to
+    float tolerance."""
+    from distributed_ddpg_tpu.actors.policy import layout_size, param_layout
+    from distributed_ddpg_tpu.serve.server import InferenceServer
+
+    layout = param_layout(3, 1, (32, 32))
+    rng = np.random.default_rng(0)
+    flat = rng.standard_normal(layout_size(layout)).astype(np.float32) * 0.1
+    obs = rng.standard_normal((8, 3)).astype(np.float32)
+
+    mesh = mesh_lib.make_mesh(4, 2)
+    tp = InferenceServer(layout, np.ones(1, np.float32), backend="jax",
+                         max_batch=8, mesh=mesh)
+    tp.refresh(flat)
+    assert tp._jax_params[0]["w"].sharding.spec == P(None, "model")
+    ref = InferenceServer(layout, np.ones(1, np.float32), backend="numpy",
+                          max_batch=8)
+    ref.refresh(flat)
+    np.testing.assert_allclose(
+        tp._compute(obs), ref._compute(obs), rtol=1e-5, atol=1e-6
+    )
+    # the numpy oracle refuses a mesh — it IS the single-device path
+    with pytest.raises(ValueError, match="numpy"):
+        InferenceServer(layout, np.ones(1, np.float32), backend="numpy",
+                        mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint portability across placement
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_across_model_axis(tmp_path):
+    """Save at model_axis=1, restore at model_axis=2 (and back):
+    checkpoints store the LOGICAL (unsharded) state — like the sharded
+    replay ring's wire format — so the roundtrip is bit-identical and a
+    run can change its TP degree at any resume point."""
+    from distributed_ddpg_tpu import checkpoint as ckpt_lib
+
+    cfg = DDPGConfig(actor_hidden=(32, 32), critic_hidden=(32, 32))
+    state = init_train_state(cfg, OBS, ACT, seed=0)
+
+    def place(model_axis):
+        mesh = mesh_lib.make_mesh(8 // model_axis, model_axis)
+        return jax.device_put(
+            state, mesh_lib.to_named(mesh, mesh_lib.state_pspec(state, mesh))
+        ), mesh
+
+    st1, _ = place(1)
+    ckpt_lib.save(str(tmp_path / "a"), 7, st1, config=cfg)
+    restored, step, _ = ckpt_lib.restore(str(tmp_path / "a"), state)
+    assert step == 7
+    st2, mesh2 = place(2)
+    # restore lands host-side; placing it under the TP mesh is the
+    # train.py resume path (device_put with the learner's sharding)
+    st2_restored = jax.device_put(
+        restored, mesh_lib.to_named(mesh2, mesh_lib.state_pspec(state, mesh2))
+    )
+    assert st2_restored.actor_params[0]["w"].sharding.spec == P(None, "model")
+    for a, b in zip(jax.tree.leaves(jax.device_get(st2_restored)),
+                    jax.tree.leaves(jax.device_get(st1))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # and back: save the TP-placed tree, restore replicated, bit-identical
+    ckpt_lib.save(str(tmp_path / "b"), 9, st2_restored, config=cfg)
+    back, _, _ = ckpt_lib.restore(str(tmp_path / "b"), state)
+    for a, b in zip(jax.tree.leaves(back),
+                    jax.tree.leaves(jax.device_get(st1))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# mesh_* observability (metrics.MeshStats; docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+
+def test_runs_summarize_and_compare_render_mesh_digest(tmp_path):
+    """tools.runs renders the mesh_* family as its own digest section;
+    compare deltas the per-device bytes (lower-is-better) and treats the
+    mesh shape as context."""
+    import json
+
+    from distributed_ddpg_tpu.tools import runs
+
+    path = tmp_path / "mesh.jsonl"
+    recs = [
+        {"kind": "train", "step": 100, "wall_time": 1.0,
+         "mesh_data_axis": 4, "mesh_model_axis": 2,
+         "mesh_param_bytes_per_device": 1000,
+         "mesh_param_bytes_total": 2000},
+        {"kind": "final", "step": 200, "wall_time": 2.0,
+         "mesh_data_axis": 4, "mesh_model_axis": 2,
+         "mesh_param_bytes_per_device": 1000,
+         "mesh_param_bytes_total": 2000},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    digest = runs.summarize_run(str(path))
+    assert digest["mesh"]["mesh_model_axis"]["last"] == 2
+    assert digest["mesh"]["mesh_param_bytes_per_device"]["last"] == 1000
+    text = runs.render_summary(digest)
+    assert "mesh / tensor parallelism" in text
+    assert "mesh_param_bytes_per_device" in text
+    _, rows = runs.compare_runs(str(path), str(path))
+    assert any(r[0] == "mesh_param_bytes_per_device" for r in rows)
+    assert not any(r[0] == "mesh_model_axis" for r in rows)
+
+
+def test_mesh_stats_measures_tp_bytes():
+    """mesh_param_bytes_per_device is read from live sharding metadata
+    and divides by the model axis for the rule-sharded majority."""
+    from distributed_ddpg_tpu.metrics import MeshStats
+
+    state = init_train_state(
+        DDPGConfig(actor_hidden=(64, 64), critic_hidden=(64, 64)), OBS, ACT, 0
+    )
+
+    def bytes_at(model_axis):
+        mesh = mesh_lib.make_mesh(8 // model_axis, model_axis)
+        placed = jax.device_put(
+            state, mesh_lib.to_named(mesh, mesh_lib.state_pspec(state, mesh))
+        )
+        snap = MeshStats(mesh.shape["data"], model_axis).snapshot(
+            jax.tree.leaves(placed)
+        )
+        assert snap["mesh_model_axis"] == model_axis
+        assert snap["mesh_param_bytes_total"] == sum(
+            int(np.prod(np.asarray(l.shape, dtype=np.int64)))
+            * l.dtype.itemsize
+            for l in jax.tree.leaves(placed)
+        )
+        return snap["mesh_param_bytes_per_device"]
+
+    full, half = bytes_at(1), bytes_at(2)
+    # 64-wide hiddens shard cleanly; final layers + the 66-wide critic
+    # insert layer replicate, so the ratio sits between 1.5 and 2.
+    assert 1.5 < full / half <= 2.0

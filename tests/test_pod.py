@@ -412,6 +412,9 @@ def _launch_pod_retrying(nprocs: int, env: dict, timeout: int, attempts: int = 3
     return last
 
 
+# Re-tiered to slow (ISSUE 15 tier-1 budget): 66s gloo 2-process spawn+compile; all multi-process pod smokes now
+# ride the slow tier — the single-process deadline units stay tier-1
+@pytest.mark.slow
 def test_two_process_peer_hang_exits_pod_degraded(tmp_path):
     """Fast 2-process deadline test (tier-1): process 1 freezes inside
     its first steady-state lockstep beat (pod:1:hang@1); BOTH processes must exit

@@ -60,8 +60,10 @@ def test_live_tree_clean_with_committed_goldens(tmp_path):
     assert names == {p.stem for p in GOLDEN.glob("*.json")}
     assert len(names) >= 18
     # Compile-free tracing budget: analysis time only (not the jax
-    # import), so box contention can't red it.
-    assert rep["elapsed_s"] < 30.0
+    # import), so box contention can't red it. 45 s since the six .tp
+    # program variants (PR 15, docs/MESH.md) grew the registry 26 -> 32
+    # — the pre-TP registry traced in ~30 s cold on the contended box.
+    assert rep["elapsed_s"] < 45.0
 
 
 def test_every_spec_module_is_watched_by_changed_only():

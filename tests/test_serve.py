@@ -556,6 +556,9 @@ def test_train_smoke_served_actors(tmp_path):
     )
 
 
+# Re-tiered to slow (ISSUE 15 tier-1 budget): 87s fault-injected train soak; test_train_smoke_served_actors keeps
+# the tier-1 serve train smoke
+@pytest.mark.slow
 def test_chaos_served_actors_degrade_to_local_act(tmp_path):
     """The serve chaos contract (docs/SERVING.md): a dispatch crash AND a
     batcher stall both push served workers onto their local act() path —
