@@ -336,16 +336,21 @@ class DeviceActorPool:
         streams are bit-identical for the same params/carry/key."""
         return self._rollout_fn
 
-    def absorb_fused_chunk(self, carry: ActorCarry, dur_s: float) -> None:
+    def absorb_fused_chunk(self, carry: ActorCarry, dur_s: float,
+                           beats: int = 1) -> None:
         """Install the rollout carry returned by a fused megastep beat and
         advance the host counters exactly as run_chunk would. The rollout
         ran INSIDE the beat program, so there is no separate dispatch to
         time — dur_s is the whole beat, and devactor_chunk_ms equals the
-        fused beat time in fused mode (docs/FUSED_BEAT.md)."""
+        fused beat time in fused mode (docs/FUSED_BEAT.md). A B-beat
+        superstep passes beats=B: one dispatch that rolled out B chunks
+        (step accounting scales; the chunk timer records the whole
+        superstep as one dispatch, so devactor_chunk_ms reads as the
+        superstep time — the amortization IS the point)."""
         self._carry = carry
-        self._stats.record_chunk(self.rows_per_chunk, dur_s)
+        self._stats.record_chunk(self.rows_per_chunk * beats, dur_s)
         self._dispatches += 1
-        self._steps += self.rows_per_chunk
+        self._steps += self.rows_per_chunk * beats
 
     # --- rollout-state checkpointing (docs/DEVICE_ACTORS.md) ---
 

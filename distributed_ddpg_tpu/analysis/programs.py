@@ -566,6 +566,7 @@ def analyze(
 SPEC_MODULES = (
     "distributed_ddpg_tpu.parallel.learner",
     "distributed_ddpg_tpu.parallel.megastep",
+    "distributed_ddpg_tpu.parallel.superstep",
     "distributed_ddpg_tpu.replay.device",
     "distributed_ddpg_tpu.actors.device_pool",
     "distributed_ddpg_tpu.serve.server",

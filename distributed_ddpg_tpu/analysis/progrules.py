@@ -5,7 +5,7 @@ The dynamic analyzer traces the compiled programs; this module holds the
 jit-KEY hazards that are visible without tracing anything — shapes that
 make XLA recompile the same program over and over, which on a pod means
 every replica pays the multi-second compile inside the training loop
-(and on the serve path, inside a request deadline). One rule, three
+(and on the serve path, inside a request deadline). One rule, four
 concrete shapes, all of which have shipped somewhere as "why is the TPU
 idle 40% of the time":
 
@@ -23,6 +23,14 @@ idle 40% of the time":
    a tracked `jax.jit(..., static_argnums=...)` callsite: dispatch
    raises TypeError the first time that path runs — on the pod, at beat
    cadence.
+4. a `jax.jit(...)` inside the TRACED body callable of
+   `lax.fori_loop` / `lax.while_loop` / `lax.scan` (inline lambda, a
+   named def passed as the body, or a jit handed directly as the body
+   argument): the body executes under trace, so the nested jit
+   re-enters the jit machinery on every (re)composition of the
+   enclosing program — the compile-once superstep contract
+   (parallel/superstep.py) requires the loop body to stay jit-free,
+   with the one jit wrapping the whole loop.
 
 Registered into the same registry as rules.py, so `tools.lint`, the
 suppression grammar, and `--rules recompile-hazard` all apply; the
@@ -99,6 +107,20 @@ _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
                ast.SetComp)
 
 
+# Traced-loop callsites and the arg positions holding traced callables:
+# fori_loop(lower, upper, BODY, init); while_loop(COND, BODY, init);
+# scan(BODY, init, xs). Bare `scan` is deliberately absent — the name is
+# too generic to claim without a lax/jax.lax qualifier (host-side scan
+# helpers exist); `fori_loop`/`while_loop` are distinctive enough bare.
+_TRACED_LOOP_BODY_ARGS: Dict[str, Tuple[int, ...]] = {}
+for _base, _pos in (("fori_loop", (2,)), ("while_loop", (0, 1)),
+                    ("scan", (0,))):
+    for _prefix in ("lax.", "jax.lax."):
+        _TRACED_LOOP_BODY_ARGS[_prefix + _base] = _pos
+_TRACED_LOOP_BODY_ARGS["fori_loop"] = (2,)
+_TRACED_LOOP_BODY_ARGS["while_loop"] = (0, 1)
+
+
 def _walk_skipping_deferred(stmt: ast.stmt) -> Iterable[ast.AST]:
     """ast.walk minus the bodies of nested def/lambda: a def or lambda
     inside a loop DEFERS execution, so a jit call in its body runs when
@@ -131,13 +153,19 @@ class RecompileHazard(Rule):
     doc = (
         "no jax.jit inside a loop body, no jit-and-call in one "
         "expression inside a function, no unhashable literal at a "
-        "static_argnums position"
+        "static_argnums position, and no jit inside the traced body "
+        "callable of lax.fori_loop/while_loop/scan"
     )
 
     def check_module(self, module: Module, ctx: LintContext) -> Iterable[Finding]:
         if module.tree is None:
             return
         statics = _StaticJitScan(module.tree).static
+        fndefs: Dict[str, ast.FunctionDef] = {
+            n.name: n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
 
         def findings():
             for node in ast.walk(module.tree):
@@ -147,6 +175,7 @@ class RecompileHazard(Rule):
                     yield from self._scan_inline_jit(module, node)
                 if isinstance(node, ast.Call):
                     yield from self._check_static_args(module, node, statics)
+                    yield from self._scan_traced_body(module, node, fndefs)
 
         # ast.walk visits nested loops/defs once per ancestor scan — the
         # same hazard must report once. Messages can differ across scans
@@ -246,6 +275,73 @@ class RecompileHazard(Rule):
                     "once (module level or __init__) and dispatch through "
                     "the binding",
                 )
+
+    # -- shape 4: jit inside a traced loop body ------------------------
+
+    def _scan_traced_body(self, module: Module, call: ast.Call,
+                          fndefs: Dict[str, ast.FunctionDef]
+                          ) -> Iterable[Finding]:
+        """jax.jit inside the body callable of lax.fori_loop / while_loop
+        / scan. The body is TRACED — a nested jit there re-enters the jit
+        machinery on every (re)composition of the enclosing program. The
+        compile-once superstep (parallel/superstep.py) depends on this
+        staying clean: one jit around the whole loop, a jit-free body
+        inside it."""
+        name = dotted(call.func) or ""
+        positions = _TRACED_LOOP_BODY_ARGS.get(name)
+        if not positions:
+            return
+        site = name.rsplit(".", 1)[-1]
+        for i in positions:
+            if i >= len(call.args):
+                continue
+            body = call.args[i]
+            # The body argument IS a jit: `fori_loop(0, n, jax.jit(f), c)`.
+            if _jit_like_call(body) is not None:
+                yield module.finding(
+                    self.name, body,
+                    f"jit-wrapped callable passed as the traced body of "
+                    f"lax.{site}() — the loop body executes under trace, "
+                    "so the nested jit re-enters the jit cache on every "
+                    "composition of the enclosing program; keep the body "
+                    "jit-free and jit the function that CONTAINS the loop",
+                )
+                continue
+            # Inline lambda body, or a named def resolved in this module.
+            target = None
+            if isinstance(body, ast.Lambda):
+                target = body.body
+            elif isinstance(body, ast.Name) and body.id in fndefs:
+                target = fndefs[body.id]
+            if target is None:
+                continue
+            scan_root = (
+                [s for s in target.body]
+                if isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else [target]
+            )
+            for stmt in scan_root:
+                for node in _walk_skipping_deferred(stmt):
+                    hazard = None
+                    if (isinstance(node, ast.Call)
+                            and _jit_like_call(node) is not None):
+                        hazard = node
+                    elif isinstance(node,
+                                    (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        for dec in node.decorator_list:
+                            if (not isinstance(dec, ast.Call)
+                                    and (dotted(dec) or "") in _JIT_NAMES):
+                                hazard = dec
+                    if hazard is not None:
+                        yield module.finding(
+                            self.name, hazard,
+                            f"jax.jit inside the traced body of "
+                            f"lax.{site}() — the body runs under trace, so "
+                            "the nested jit re-traces on every composition "
+                            "of the enclosing program (and defeats the "
+                            "compile-once loop contract); hoist the jit "
+                            "out and close over the plain function",
+                        )
 
     # -- shape 3: unhashable literal at a static position --------------
 

@@ -248,6 +248,20 @@ class DDPGConfig:
     # "off": always dispatch per phase. Bit-identical to the separate
     # dispatch sequence for fixed seeds (tests/test_megastep.py).
     fused_beat: str = "auto"
+    # Compile-once multi-beat superstep (parallel/superstep.py): compose B
+    # fused beats inside one donated-carry lax.fori_loop, so an entire
+    # epoch — B x (sample+learn, rollout, scatter, guardrail probe) — is a
+    # SINGLE XLA program per dispatch and per-beat host Python goes to
+    # zero (the full Anakin epoch-as-one-dispatch shape, PAPERS.md arXiv
+    # 2104.06272; host-orchestration overhead per arXiv 2012.04210).
+    # Stats/health accumulate in a device-side carry with ONE device_get
+    # per superstep; multi-host sync_ship/ingest beats still ride BETWEEN
+    # supersteps. 1 (default) = today's per-beat dispatch, bit-identical
+    # oracle; B > 1 requires the fused beat to be active (fused_beat !=
+    # 'off') and produces bit-identical state to B sequential beats
+    # (tests/test_superstep.py). Budget/cadence checks run once per
+    # superstep, so env-budget overshoot is bounded by B x rows-per-beat.
+    superstep_beats: int = 1
 
     # --- exploration (SURVEY.md §2 #6) ---
     ou_theta: float = 0.15
@@ -961,6 +975,17 @@ class DDPGConfig:
                     "independently dispatchable phases to throttle — "
                     "disable the gates or use fused_beat='auto'/'off'"
                 )
+        if self.superstep_beats < 1:
+            raise ValueError(
+                f"superstep_beats must be >= 1, got {self.superstep_beats}"
+            )
+        if self.superstep_beats > 1 and self.fused_beat == "off":
+            raise ValueError(
+                "superstep_beats > 1 composes B FUSED beats into one "
+                "lax.fori_loop program (parallel/superstep.py) — it has "
+                "no unfused dispatch to wrap; use fused_beat='auto'/'on' "
+                "or superstep_beats=1"
+            )
         # Fail fast on fault-grammar typos: a bad spec must die at config
         # parse, not hours later when the fault was scheduled to fire.
         from distributed_ddpg_tpu.faults import FaultPlan

@@ -499,6 +499,13 @@ class FusedBeatStats:
                             reservoir tails of the same (a p95 spike
                             means the single beat program started
                             synchronizing against the host)
+      fused_supersteps      superstep DISPATCHES in the interval — equals
+                            fused_beats for the plain megastep, and
+                            fused_beats / B for a B-beat superstep
+                            (parallel/superstep.py): the host-overhead
+                            amortization the BENCH_SUPERSTEP row measures
+      fused_superstep_beats beats per dispatch over the interval (B; 1.0
+                            for the plain megastep)
     """
 
     def __init__(self, seed: int = 0):
@@ -506,6 +513,7 @@ class FusedBeatStats:
         self._seed = seed
         self._t0 = time.monotonic()
         self._beats = 0
+        self._supersteps = 0
         self._steps = 0
         self._rows = 0
         self._dur_s = 0.0
@@ -514,9 +522,16 @@ class FusedBeatStats:
             (zlib.crc32(b"fused_beat") ^ seed) & 0x7FFFFFFF,
         )
 
-    def record_beat(self, learn_steps: int, rows: int, dur_s: float) -> None:
+    def record_beat(self, learn_steps: int, rows: int, dur_s: float,
+                    beats: int = 1) -> None:
+        # One call per DISPATCH: a B-beat superstep records its whole
+        # loop here (beats=B), so fused_beats keeps counting training
+        # beats while the dispatch counter amortizes by B. The duration
+        # reservoir keeps whole-dispatch wall times — tails measure what
+        # the host actually waits on.
         with self._lock:
-            self._beats += 1
+            self._beats += int(beats)
+            self._supersteps += 1
             self._steps += int(learn_steps)
             self._rows += int(rows)
             self._dur_s += dur_s
@@ -540,10 +555,16 @@ class FusedBeatStats:
                     1000.0 * self._res.percentile(0.95), 3
                 ),
                 "fused_beat_max": round(1000.0 * self._res.max, 3),
+                "fused_supersteps": self._supersteps,
+                "fused_superstep_beats": (
+                    round(n / self._supersteps, 2) if self._supersteps
+                    else 0.0
+                ),
             }
             if reset:
                 self._t0 = time.monotonic()
                 self._beats = 0
+                self._supersteps = 0
                 self._steps = 0
                 self._rows = 0
                 self._dur_s = 0.0

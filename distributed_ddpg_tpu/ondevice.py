@@ -280,6 +280,57 @@ class OnDeviceDDPG:
             ),
             donate_argnums=(0,),
         )
+        # --- compile-once multi-chunk superstep (config.superstep_beats;
+        # parallel/superstep.py is the jax_tpu sibling) --- B chunk bodies
+        # inside one donated-carry fori_loop: the ChunkStats rows stack
+        # into a device-side [B, ...] carry, and finalize_stats pays ONE
+        # device_get for the whole superstep. ALL B chunks run inside the
+        # loop body (stats zero-initialized from eval_shape at trace
+        # time): the body compiles as its own isolated computation with
+        # the same codegen as the standalone chunk program — inlining the
+        # first chunk instead lets XLA cross-optimize it with the loop
+        # and diverge at ULP level (parallel/superstep.py, same finding).
+        # Scope: exact parity is a SINGLE-device property; on a
+        # multi-device mesh XLA schedules the collectives differently in
+        # the loop body than in the standalone program, so SPMD runs
+        # agree only to float32 tolerance (tests/test_superstep.py).
+        self.superstep_beats = int(config.superstep_beats)
+        self._superstep = None
+        if self.superstep_beats > 1:
+            B = self.superstep_beats
+
+            def superstep(carry: Carry):
+                stats_shapes = jax.eval_shape(chunk, carry)[1]
+                stacked = jax.tree.map(
+                    lambda s: jnp.zeros((B,) + s.shape, s.dtype),
+                    stats_shapes,
+                )
+
+                def body(i, acc):
+                    carry, stacked = acc
+                    carry, s = chunk(carry)
+                    stacked = jax.tree.map(
+                        lambda a, x: a.at[i].set(x), stacked, s
+                    )
+                    return carry, stacked
+
+                return jax.lax.fori_loop(0, B, body, (carry, stacked))
+
+            stacked_spec = ChunkStats(
+                metrics={k: P(None) for k in METRIC_KEYS},
+                learn_steps=P(None),
+                dones=P(None, None, env_axis),
+                ep_returns=P(None, None, env_axis),
+            )
+            self._superstep = jax.jit(
+                superstep,
+                in_shardings=(self._carry_sharding,),
+                out_shardings=(
+                    self._carry_sharding,
+                    mesh_lib.to_named(self.mesh, stacked_spec),
+                ),
+                donate_argnums=(0,),
+            )
         self.carry: Carry = jax.device_put(carry, self._carry_sharding)
         self._env_steps = 0
         self._learn_steps = 0
@@ -292,13 +343,42 @@ class OnDeviceDDPG:
         self._env_steps += self.chunk_size * self.num_envs
         return stats
 
+    def run_superstep(self) -> ChunkStats:
+        """B chunks as ONE fori_loop dispatch (superstep_beats > 1):
+        B*K*E env steps + up-to-B*K learner steps, stats stacked [B, ...]
+        on device — finalize_stats flattens them in the same single
+        device_get a lone chunk pays."""
+        self.carry, stats = self._superstep(self.carry)
+        self._env_steps += (
+            self.superstep_beats * self.chunk_size * self.num_envs
+        )
+        return stats
+
     def finalize_stats(self, stats: ChunkStats) -> dict:
-        """Device stats -> host floats (one sync point per chunk)."""
+        """Device stats -> host floats (one sync point per dispatch).
+        Accepts a single chunk's stats OR a superstep's stacked [B, ...]
+        rows (detected by learn_steps rank): stacked rows flatten so the
+        episode accounting is identical to B sequential chunks, and the
+        metric means re-weight by each chunk's learned-iteration count
+        (each row is already a per-chunk mean; an unweighted mean would
+        skew toward warmup chunks that learned less)."""
         host = jax.device_get(stats)
-        self._learn_steps += int(host.learn_steps)
+        ls = np.asarray(host.learn_steps)
         dones = np.asarray(host.dones)
-        rets = np.asarray(host.ep_returns)[dones]
-        out = {k: float(v) for k, v in host.metrics.items()}
+        rets = np.asarray(host.ep_returns)
+        if ls.ndim == 0:
+            self._learn_steps += int(ls)
+            out = {k: float(v) for k, v in host.metrics.items()}
+        else:
+            self._learn_steps += int(ls.sum())
+            dones = dones.reshape((-1,) + dones.shape[2:])
+            rets = rets.reshape((-1,) + rets.shape[2:])
+            w = ls.astype(np.float64) / max(float(ls.sum()), 1.0)
+            out = {
+                k: float((np.asarray(v, np.float64) * w).sum())
+                for k, v in host.metrics.items()
+            }
+        rets = rets[dones]
         out["episodes"] = int(dones.sum())
         if rets.size:
             out["episode_return"] = float(rets.mean())
@@ -375,4 +455,17 @@ def program_specs():
         od = OnDeviceDDPG(config, mesh=probe_mesh(), chunk_size=2)
         return BuiltProgram(od._chunk, (od.carry,), (0,))
 
-    return [ProgramSpec("ondevice.chunk", "ondevice.py", build)]
+    def build_superstep():
+        # B=2: the smallest loop that actually iterates. The fori_loop's
+        # donated carry includes the ring — aliasing must survive the
+        # loop composition or the superstep doubles the RING in HBM.
+        config = probe_config(
+            num_actors=4, warmup_uniform_steps=8, superstep_beats=2
+        )
+        od = OnDeviceDDPG(config, mesh=probe_mesh(), chunk_size=2)
+        return BuiltProgram(od._superstep, (od.carry,), (0,))
+
+    return [
+        ProgramSpec("ondevice.chunk", "ondevice.py", build),
+        ProgramSpec("ondevice.superstep", "ondevice.py", build_superstep),
+    ]

@@ -151,6 +151,12 @@ class ShardedLearner:
             else {}
         )
         self._health_cur = None
+        # Superstep first-bad-beat accounting (parallel/superstep.py): the
+        # anomaly count (nonfinite + spikes) as of the LAST poll, so a
+        # stacked [B, 5] health fetch can localize which beat of the
+        # superstep first went bad. Survives reset_guard — the cumulative
+        # counters it differences against survive too.
+        self._health_prev_anom = 0
         # LR cooldown hook (train.py rollback-repair): both LRs scale by
         # _lr_scale; set_lr_scale rebuilds the (lazily compiled) programs.
         self._lr_scale = 1.0
@@ -1044,10 +1050,15 @@ class ShardedLearner:
         return self._pure_scan_fns[key]
 
     def note_fused_health(self, guard, health, bad_idx) -> None:
-        """Install the guard state + health word a fused megastep beat
+        """Install the guard state + health word(s) a fused dispatch
         returned, so poll_health()/bad_indices() (the train.py guardrail
         monitor) read the fused program's probe exactly as they read a
-        standalone guarded chunk's."""
+        standalone guarded chunk's. A megastep beat hands a scalar health
+        word (int32[5]) and bad-row capture (int32[GUARD_BAD_IDX]); a
+        B-beat superstep (parallel/superstep.py) hands the stacked
+        per-beat VECTORS (int32[B, 5] / int32[B, GUARD_BAD_IDX]) — the
+        final row is the chunk-end cumulative counters, and the per-row
+        deltas localize the first bad beat."""
         self._guard = guard
         self._health_cur = (health, bad_idx)
 
@@ -1090,10 +1101,20 @@ class ShardedLearner:
     # --- numerical-health guardrails (guardrails.py) ---
 
     def poll_health(self) -> Optional[Dict[str, int]]:
-        """Cumulative probe counters of the most recent guarded chunk —
-        the one tiny d2h the guardrail monitor pays per chunk (it syncs
-        the chunk's health word only, never params). None before the
-        first guarded dispatch or with guardrails off."""
+        """Cumulative probe counters of the most recent guarded dispatch
+        — the one tiny d2h the guardrail monitor pays per sync point (it
+        syncs the health word only, never params). None before the first
+        guarded dispatch or with guardrails off.
+
+        A superstep's stacked int32[B, 5] health vector (note_fused_
+        health) syncs in the SAME single device_get: the returned dict is
+        the final row (chunk-end cumulative counters, exactly what B
+        sequential polls would have converged to), plus a
+        "first_bad_beat" entry — the 0-based index of the first beat
+        whose cumulative anomaly count (nonfinite + spikes) moved past
+        the previous poll's, or -1 when the superstep was clean. Scalar
+        fetches carry no such key, so GuardrailStats.absorb's .get-based
+        delta accounting is untouched."""
         if not self.guard_enabled or self._health_cur is None:
             return None
         from distributed_ddpg_tpu import guardrails as guard_lib
@@ -1101,16 +1122,32 @@ class ShardedLearner:
         def fetch():
             with trace.span("health_d2h"):
                 vec = np.asarray(jax.device_get(self._health_cur[0]))
-            return dict(
-                zip(guard_lib.HEALTH_KEYS, (int(v) for v in vec))
-            )
+            if vec.ndim == 1:
+                return dict(
+                    zip(guard_lib.HEALTH_KEYS, (int(v) for v in vec))
+                )
+            # Stacked [B, 5] superstep vector: one fetch, per-beat rows.
+            keys = guard_lib.HEALTH_KEYS
+            anom = (
+                vec[:, keys.index("nonfinite")] + vec[:, keys.index("spikes")]
+            ).astype(np.int64)
+            fresh = np.flatnonzero(anom > self._health_prev_anom)
+            h = dict(zip(keys, (int(v) for v in vec[-1])))
+            h["first_bad_beat"] = int(fresh[0]) if fresh.size else -1
+            return h
 
         if self.transfer is None:
-            return fetch()
-        return self.transfer.run_inline(
-            "d2h", fetch, label="health_d2h",
-            nbytes_of=lambda r: 4 * len(r),
-        )
+            h = fetch()
+        else:
+            h = self.transfer.run_inline(
+                "d2h", fetch, label="health_d2h",
+                nbytes_of=lambda r: 4 * len(r),
+            )
+        if h is not None:
+            self._health_prev_anom = (
+                int(h.get("nonfinite", 0)) + int(h.get("spikes", 0))
+            )
+        return h
 
     def bad_indices(self) -> np.ndarray:
         """Replay indices of the non-finite rows the last guarded chunk
@@ -1123,7 +1160,9 @@ class ShardedLearner:
         if bad is None:
             return np.empty(0, np.int64)
         arr = np.asarray(jax.device_get(bad)).astype(np.int64)
-        return arr[arr >= 0]
+        # A superstep hands the stacked [B, GUARD_BAD_IDX] capture;
+        # beat order is row order, so a flatten preserves it.
+        return arr.reshape(-1)[arr.reshape(-1) >= 0]
 
     def reset_guard(self) -> None:
         """Re-arm the probe after a rollback: EWMA statistics reset (the

@@ -76,6 +76,121 @@ from distributed_ddpg_tpu.learner import METRIC_KEYS, StepOutput
 from distributed_ddpg_tpu.metrics import FusedBeatStats
 
 
+def build_beat_body(learner, pool, replay, per: bool, guard: bool,
+                    rows_per_beat: int):
+    """The pure fused-beat body and its jit contract for one
+    (per, guard) variant: `(beat, in_shardings, out_shardings,
+    donate_argnums)`. FusedMegastep jits it directly (one beat per
+    dispatch); parallel/superstep.py composes the SAME body B times
+    inside one lax.fori_loop — sharing the construction is what makes
+    superstep-vs-sequential bit-identity structural rather than
+    coincidental."""
+    L = learner
+    mesh = L.mesh
+    m = int(rows_per_beat)
+    insert_fn = replay.pure_insert_device_rows_fn(m)
+    stamp_fn = replay.pure_stamp_fn(m) if per else None
+    rollout_fn = pool.rollout_fn
+    sample_fn = L.pure_scan_sample_fn(per)
+
+    replicated = NamedSharding(mesh, P())
+    storage_sharding = NamedSharding(
+        mesh, P("data", None) if replay.sharded else P(None, None)
+    )
+    prio_sharding = NamedSharding(
+        mesh, P("data") if replay.sharded else P(None)
+    )
+    carry_sharding = pool._carry_sharding
+    out_step = StepOutput(
+        state=L._state_sharding,
+        td_errors=NamedSharding(mesh, P(None, "data")),
+        metrics={k: replicated for k in METRIC_KEYS},
+    )
+
+    # The beat bodies below are the loop iteration verbatim: learn on
+    # the current ring, roll out with the updated params, scatter.
+    # `ptr` is threaded through untouched by the learner leg; PER
+    # stamps from the PRE-insert pointer (the insert_device_rows
+    # ordering).
+    if not per and not guard:
+
+        def beat(state, key, storage, ptr, size, carry):
+            out, key = sample_fn(state, key, storage, size)
+            carry, rows = rollout_fn(out.state.actor_params, carry)
+            storage, ptr, size = insert_fn(storage, rows, ptr, size)
+            return out, key, storage, ptr, size, carry
+
+        in_sh = (L._state_sharding, replicated, storage_sharding,
+                 replicated, replicated, carry_sharding)
+        out_sh = (out_step, replicated, storage_sharding,
+                  replicated, replicated, carry_sharding)
+        donate = (0, 1, 2, 3, 4, 5)
+    elif not per and guard:
+
+        def beat(state, key, storage, ptr, size, carry, g):
+            out, key, g, health, bad_idx = sample_fn(
+                state, key, storage, size, g
+            )
+            carry, rows = rollout_fn(out.state.actor_params, carry)
+            storage, ptr, size = insert_fn(storage, rows, ptr, size)
+            return (out, key, storage, ptr, size, carry, g, health,
+                    bad_idx)
+
+        in_sh = (L._state_sharding, replicated, storage_sharding,
+                 replicated, replicated, carry_sharding, replicated)
+        out_sh = (out_step, replicated, storage_sharding, replicated,
+                  replicated, carry_sharding, replicated, replicated,
+                  replicated)
+        donate = (0, 1, 2, 3, 4, 5, 6)
+    elif per and not guard:
+
+        def beat(state, key, storage, ptr, size, carry, priorities,
+                 maxp, beta, alpha, eps):
+            out, key, priorities, maxp = sample_fn(
+                state, key, storage, size, priorities, maxp, beta,
+                alpha, eps,
+            )
+            carry, rows = rollout_fn(out.state.actor_params, carry)
+            old_ptr = ptr
+            storage, ptr, size = insert_fn(storage, rows, ptr, size)
+            priorities = stamp_fn(priorities, maxp, old_ptr)
+            return (out, key, storage, ptr, size, carry, priorities,
+                    maxp)
+
+        in_sh = (L._state_sharding, replicated, storage_sharding,
+                 replicated, replicated, carry_sharding, prio_sharding,
+                 replicated, replicated, replicated, replicated)
+        out_sh = (out_step, replicated, storage_sharding, replicated,
+                  replicated, carry_sharding, prio_sharding,
+                  replicated)
+        donate = (0, 1, 2, 3, 4, 5, 6)
+    else:
+
+        def beat(state, key, storage, ptr, size, carry, priorities,
+                 maxp, beta, alpha, eps, g):
+            out, key, priorities, maxp, g, health, bad_idx = sample_fn(
+                state, key, storage, size, priorities, maxp, beta,
+                alpha, eps, g,
+            )
+            carry, rows = rollout_fn(out.state.actor_params, carry)
+            old_ptr = ptr
+            storage, ptr, size = insert_fn(storage, rows, ptr, size)
+            priorities = stamp_fn(priorities, maxp, old_ptr)
+            return (out, key, storage, ptr, size, carry, priorities,
+                    maxp, g, health, bad_idx)
+
+        in_sh = (L._state_sharding, replicated, storage_sharding,
+                 replicated, replicated, carry_sharding, prio_sharding,
+                 replicated, replicated, replicated, replicated,
+                 replicated)
+        out_sh = (out_step, replicated, storage_sharding, replicated,
+                  replicated, carry_sharding, prio_sharding,
+                  replicated, replicated, replicated, replicated)
+        donate = (0, 1, 2, 3, 4, 5, 6, 11)
+
+    return beat, in_sh, out_sh, donate
+
+
 class FusedMegastep:
     """One jitted beat program over (learner, device-actor pool, device
     replay) — see module docstring. Constructed by train.py when
@@ -96,109 +211,10 @@ class FusedMegastep:
         self._build()
 
     def _build(self) -> None:
-        L, pool, replay = self.learner, self.pool, self.replay
-        mesh = L.mesh
-        m = self.rows_per_beat
-        insert_fn = replay.pure_insert_device_rows_fn(m)
-        stamp_fn = replay.pure_stamp_fn(m) if self.per else None
-        rollout_fn = pool.rollout_fn
-        sample_fn = L.pure_scan_sample_fn(self.per)
-
-        replicated = NamedSharding(mesh, P())
-        storage_sharding = NamedSharding(
-            mesh, P("data", None) if replay.sharded else P(None, None)
+        beat, in_sh, out_sh, donate = build_beat_body(
+            self.learner, self.pool, self.replay, self.per, self.guard,
+            self.rows_per_beat,
         )
-        prio_sharding = NamedSharding(
-            mesh, P("data") if replay.sharded else P(None)
-        )
-        carry_sharding = pool._carry_sharding
-        out_step = StepOutput(
-            state=L._state_sharding,
-            td_errors=NamedSharding(mesh, P(None, "data")),
-            metrics={k: replicated for k in METRIC_KEYS},
-        )
-
-        # The beat bodies below are the loop iteration verbatim: learn on
-        # the current ring, roll out with the updated params, scatter.
-        # `ptr` is threaded through untouched by the learner leg; PER
-        # stamps from the PRE-insert pointer (the insert_device_rows
-        # ordering).
-        if not self.per and not self.guard:
-
-            def beat(state, key, storage, ptr, size, carry):
-                out, key = sample_fn(state, key, storage, size)
-                carry, rows = rollout_fn(out.state.actor_params, carry)
-                storage, ptr, size = insert_fn(storage, rows, ptr, size)
-                return out, key, storage, ptr, size, carry
-
-            in_sh = (L._state_sharding, replicated, storage_sharding,
-                     replicated, replicated, carry_sharding)
-            out_sh = (out_step, replicated, storage_sharding,
-                      replicated, replicated, carry_sharding)
-            donate = (0, 1, 2, 3, 4, 5)
-        elif not self.per and self.guard:
-
-            def beat(state, key, storage, ptr, size, carry, g):
-                out, key, g, health, bad_idx = sample_fn(
-                    state, key, storage, size, g
-                )
-                carry, rows = rollout_fn(out.state.actor_params, carry)
-                storage, ptr, size = insert_fn(storage, rows, ptr, size)
-                return (out, key, storage, ptr, size, carry, g, health,
-                        bad_idx)
-
-            in_sh = (L._state_sharding, replicated, storage_sharding,
-                     replicated, replicated, carry_sharding, replicated)
-            out_sh = (out_step, replicated, storage_sharding, replicated,
-                      replicated, carry_sharding, replicated, replicated,
-                      replicated)
-            donate = (0, 1, 2, 3, 4, 5, 6)
-        elif self.per and not self.guard:
-
-            def beat(state, key, storage, ptr, size, carry, priorities,
-                     maxp, beta, alpha, eps):
-                out, key, priorities, maxp = sample_fn(
-                    state, key, storage, size, priorities, maxp, beta,
-                    alpha, eps,
-                )
-                carry, rows = rollout_fn(out.state.actor_params, carry)
-                old_ptr = ptr
-                storage, ptr, size = insert_fn(storage, rows, ptr, size)
-                priorities = stamp_fn(priorities, maxp, old_ptr)
-                return (out, key, storage, ptr, size, carry, priorities,
-                        maxp)
-
-            in_sh = (L._state_sharding, replicated, storage_sharding,
-                     replicated, replicated, carry_sharding, prio_sharding,
-                     replicated, replicated, replicated, replicated)
-            out_sh = (out_step, replicated, storage_sharding, replicated,
-                      replicated, carry_sharding, prio_sharding,
-                      replicated)
-            donate = (0, 1, 2, 3, 4, 5, 6)
-        else:
-
-            def beat(state, key, storage, ptr, size, carry, priorities,
-                     maxp, beta, alpha, eps, g):
-                out, key, priorities, maxp, g, health, bad_idx = sample_fn(
-                    state, key, storage, size, priorities, maxp, beta,
-                    alpha, eps, g,
-                )
-                carry, rows = rollout_fn(out.state.actor_params, carry)
-                old_ptr = ptr
-                storage, ptr, size = insert_fn(storage, rows, ptr, size)
-                priorities = stamp_fn(priorities, maxp, old_ptr)
-                return (out, key, storage, ptr, size, carry, priorities,
-                        maxp, g, health, bad_idx)
-
-            in_sh = (L._state_sharding, replicated, storage_sharding,
-                     replicated, replicated, carry_sharding, prio_sharding,
-                     replicated, replicated, replicated, replicated,
-                     replicated)
-            out_sh = (out_step, replicated, storage_sharding, replicated,
-                      replicated, carry_sharding, prio_sharding,
-                      replicated, replicated, replicated, replicated)
-            donate = (0, 1, 2, 3, 4, 5, 6, 11)
-
         self._beat = jax.jit(
             beat,
             in_shardings=in_sh,
@@ -206,7 +222,7 @@ class FusedMegastep:
             donate_argnums=donate,
         )
         self._donate = donate
-        self._learner_version = L.programs_version
+        self._learner_version = self.learner.programs_version
 
     # --- driving ---
 

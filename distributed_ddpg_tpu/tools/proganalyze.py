@@ -36,6 +36,7 @@ _DEFAULT_GOLDEN = _REPO_ROOT / "tests" / "golden_programs"
 _OWNER_FILES = (
     "distributed_ddpg_tpu/parallel/learner.py",
     "distributed_ddpg_tpu/parallel/megastep.py",
+    "distributed_ddpg_tpu/parallel/superstep.py",
     "distributed_ddpg_tpu/replay/device.py",
     "distributed_ddpg_tpu/actors/device_pool.py",
     "distributed_ddpg_tpu/serve/server.py",

@@ -287,17 +287,29 @@ def train_ondevice(config: DDPGConfig) -> Dict[str, float]:
     # any chunk); aggregate across chunks between log events.
     episodes_acc, return_acc = 0, []
 
+    # superstep_beats > 1: B chunks per dispatch (ondevice.run_superstep),
+    # one stats device_get per superstep instead of per chunk. The log
+    # cadence below becomes a crossing test so a B that doesn't divide
+    # the 10-chunk stride still logs on every stride crossed.
+    beats = max(1, trainer.superstep_beats)
+    rows_per_dispatch = trainer.chunk_size * trainer.num_envs * beats
+    log_stride = trainer.chunk_size * trainer.num_envs * 10
     with profile_cm:
         while env_steps() < config.total_env_steps:
             before = trainer.learn_steps
-            stats = trainer.run_chunk()
+            stats = (
+                trainer.run_superstep() if beats > 1 else trainer.run_chunk()
+            )
             host = trainer.finalize_stats(stats)
-            env_timer.tick(trainer.chunk_size * trainer.num_envs)
+            env_timer.tick(rows_per_dispatch)
             learn_timer.tick(trainer.learn_steps - before)
             episodes_acc += host.pop("episodes", 0)
             if "episode_return" in host:
                 return_acc.append(host.pop("episode_return"))
-            log_now = trainer.env_steps % (trainer.chunk_size * trainer.num_envs * 10) == 0
+            log_now = (
+                trainer.env_steps // log_stride
+                != (trainer.env_steps - rows_per_dispatch) // log_stride
+            )
             if env_steps() - last_eval >= config.eval_every:
                 eval_policy.load_flat(flatten_params(trainer.actor_params_to_host()))
                 eval_return = _eval_numpy(eval_policy, config, spec)
@@ -929,9 +941,25 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             )
         )
     ):
-        from distributed_ddpg_tpu.parallel.megastep import FusedMegastep
+        if config.superstep_beats > 1:
+            # Compile-once multi-beat superstep (parallel/superstep.py):
+            # B fused beats compose inside one donated-carry fori_loop —
+            # one dispatch and ONE host sync point per B iterations.
+            # FusedSuperstep is run_beat-shaped (train loop drives it
+            # through the same after_chunk), so everything downstream —
+            # fused_fields(), guardrail monitor, checkpoint cadence —
+            # sees a beat that happens to advance B chunks.
+            from distributed_ddpg_tpu.parallel.superstep import FusedSuperstep
 
-        megastep = FusedMegastep(config, learner, device_pool, device_replay)
+            megastep = FusedSuperstep(
+                config, learner, device_pool, device_replay
+            )
+        else:
+            from distributed_ddpg_tpu.parallel.megastep import FusedMegastep
+
+            megastep = FusedMegastep(
+                config, learner, device_pool, device_replay
+            )
         _beat()  # beat-program construction survived
 
     # Learner d2h pulls ride the scheduler's inline d2h class: absolute
@@ -1351,26 +1379,40 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         if delta["bad_rows"] > 0:
             _guard_quarantine_sources()
         if delta["anomalies"] > 0:
+            # first_bad_beat: only present when a multi-beat superstep's
+            # stacked health vector localized the first offending beat
+            # (learner.poll_health); -1 / absent on scalar polls.
+            first_bad = int(h.get("first_bad_beat", -1))
             trace.instant(
                 "nan_batch", step=learn_steps,
                 anomalies=delta["anomalies"],
                 nonfinite=delta["nonfinite"], spikes=delta["spikes"],
+                first_bad_beat=first_bad,
             )
             print(
                 f"[guardrail] {delta['anomalies']} anomalous learner "
                 f"step(s) in the chunk ending at {learn_steps} "
                 f"(nonfinite {delta['nonfinite']}, z-spikes "
                 f"{delta['spikes']}, bad replay rows {delta['bad_rows']})"
-                " — update(s) dropped on device",
+                + (
+                    f", first bad beat {first_bad} of the superstep"
+                    if first_bad >= 0
+                    else ""
+                )
+                + " — update(s) dropped on device",
                 file=sys.stderr, flush=True,
             )
             guard_window.append((learn_steps, delta["anomalies"]))
-        # Effective window: never narrower than two chunks. Health lands
-        # once per chunk stamped at the chunk's END, so a window below
-        # the chunk size (TPU chunks auto-resolve to 800 vs the 256-step
-        # default window) would prune every previous chunk's entry
-        # immediately and the trigger could only ever see one chunk.
-        win = max(config.guardrail_rollback_window, 2 * chunk)
+        # Effective window: never narrower than two sync points. Health
+        # lands once per chunk stamped at the chunk's END (once per
+        # SUPERSTEP — B chunks — when superstep_beats > 1), so a window
+        # below that stride (TPU chunks auto-resolve to 800 vs the
+        # 256-step default window) would prune every previous entry
+        # immediately and the trigger could only ever see one poll.
+        win = max(
+            config.guardrail_rollback_window,
+            2 * chunk * max(1, config.superstep_beats),
+        )
         lo = learn_steps - win
         guard_window[:] = [(s, n) for s, n in guard_window if s > lo]
         handled = False
@@ -1583,11 +1625,17 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     last_monitor_t = 0.0
     support_controller = support_auto.SupportController()
 
-    def after_chunk(out, indices, fused: bool = False) -> None:
+    def after_chunk(out, indices, fused: bool = False,
+                    beats: int = 1) -> None:
+        # `beats`: how many fused beats the dispatch that produced `out`
+        # advanced (a B-beat superstep passes B; everything else 1). All
+        # step accounting scales by it; `out` is the FINAL beat's output,
+        # which is exactly what B sequential after_chunk calls would have
+        # left visible at this point.
         nonlocal learn_steps, last_ckpt, next_refresh, last_eval
         nonlocal last_refresh_t, last_log_t
-        learn_steps += chunk
-        learn_timer.tick(chunk)
+        learn_steps += chunk * beats
+        learn_timer.tick(chunk * beats)
         if device_pool is not None:
             # Device-actor param refresh: pointer swap to the LIVE params,
             # re-done every chunk because the dispatch above DONATED the
@@ -1615,7 +1663,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             # devactor_step dispatch; keep the shared actor-rate meter
             # (actor_steps_per_sec) fed so a healthy fused run never
             # reads as a stalled actor fleet.
-            env_timer.tick(device_pool.rows_per_chunk)
+            env_timer.tick(device_pool.rows_per_chunk * beats)
         ingest_once(sync_wait=False)
 
         if config.prioritized and not use_device_replay:
@@ -1644,7 +1692,15 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             next_refresh = learn_steps + config.param_refresh_every
             last_refresh_t = time.perf_counter()
 
-        on_cadence = learn_steps % (50 * chunk) == 0
+        # Cadence = crossing a 50-chunk multiple, not landing on one: a
+        # B-beat superstep advances chunk*B steps per call, and B need
+        # not divide 50 — the `% == 0` form would skip every cadence
+        # whose multiple falls strictly inside a superstep. For beats=1
+        # the crossing test reduces to the exact `% == 0` it replaces.
+        on_cadence = (
+            learn_steps // (50 * chunk)
+            != (learn_steps - chunk * beats) // (50 * chunk)
+        )
         chunk_metrics = None
         support_metrics = {}
         if on_cadence and config.distributional and config.v_support_auto:
@@ -2053,7 +2109,29 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 # (docs/TRANSFER.md token protocol). No-op otherwise.
                 wait_beat()
                 if use_device_replay:
-                    if megastep is not None:
+                    if megastep is not None and config.superstep_beats > 1:
+                        # Multi-beat superstep (docs/FUSED_BEAT.md): B
+                        # fused beats as ONE fori_loop program. The PER
+                        # beta anneal rides in as a host-precomputed
+                        # float32[B] vector reproducing the per-beat
+                        # sequential schedule (globally-agreed budget_now
+                        # so replicas never fork; rows advance
+                        # rows_per_chunk per in-loop beat).
+                        betas = None
+                        if config.prioritized:
+                            from distributed_ddpg_tpu.parallel.superstep \
+                                import per_beat_betas
+
+                            betas = per_beat_betas(
+                                config, budget_now, megastep.beats,
+                                device_pool.rows_per_chunk,
+                            )
+                        with phases.phase("dispatch"):
+                            out = megastep.run_superstep(betas=betas)
+                        after_chunk(
+                            out, None, fused=True, beats=megastep.beats
+                        )
+                    elif megastep is not None:
                         # Fused megastep (docs/FUSED_BEAT.md): rollout +
                         # scatter + sample + K updates in ONE program. The
                         # PER beta anneal rides in as a scalar exactly like

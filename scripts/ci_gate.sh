@@ -47,6 +47,12 @@
 #              baselines and arms once a BENCH_FUSED=1 bench becomes
 #              the baseline — the fused megastep regressing toward the
 #              dispatch-per-phase rate is a fusion regression, not noise;
+#              plus the higher-is-better superstep_steps_per_s pin
+#              (docs/FUSED_BEAT.md §superstep), which SKIPs against
+#              pre-superstep baselines and arms once a BENCH_SUPERSTEP=1
+#              bench becomes the baseline — the compile-once fori_loop
+#              dispatch regressing toward the per-beat dispatch rate is
+#              an amortization regression, not noise;
 #              plus the tensor-parallel pins (docs/MESH.md): the
 #              lower-is-better tp_param_bytes_per_device placement fact
 #              (a candidate whose TP placement holds MORE state bytes
@@ -83,7 +89,7 @@ while :; do
 done
 candidate="${1:?usage: ci_gate.sh [--lint] [--programs] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,superstep_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).

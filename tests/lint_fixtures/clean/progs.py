@@ -35,3 +35,12 @@ def good_loop(xs):
 
 def good_static_tuple(x):
     return step(x, (1, 2))
+
+
+def good_fori_body(x):
+    # The superstep idiom: the traced fori_loop body closes over a PLAIN
+    # hoisted callable; the one jit wraps the function containing the
+    # loop (not shown) — the body itself stays jit-free.
+    def body(i, c):
+        return apply_fn(c)
+    return jax.lax.fori_loop(0, 4, body, x)

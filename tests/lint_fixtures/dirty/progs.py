@@ -29,3 +29,12 @@ def bad_inline_jit(x):
 
 def bad_static_list(x):
     return step(x, [1, 2])
+
+
+def bad_fori_body_jit(x):
+    def body(i, c):
+        # jit inside the TRACED loop body: re-enters the jit machinery
+        # on every composition of the enclosing program.
+        f = jax.jit(lambda v: v + 1)
+        return f(c)
+    return jax.lax.fori_loop(0, 4, body, x)

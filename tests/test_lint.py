@@ -78,10 +78,10 @@ def test_dirty_tree_fires_every_rule_with_expected_counts():
         "typed-error": 2,
         "lock-discipline": 4,
         "observability-drift": 3,
-        "recompile-hazard": 4,
+        "recompile-hazard": 5,
     }
     # Nothing in the dirty tree is suppressed — every finding gates.
-    assert len(result.unsuppressed) == len(result.findings) == 29
+    assert len(result.unsuppressed) == len(result.findings) == 30
 
 
 def test_dirty_tree_known_bad_locations():
@@ -95,12 +95,13 @@ def test_dirty_tree_known_bad_locations():
     # The local-def factory idiom tracks the FULL multi-arg donate tuple:
     # reading position 4 (not just arg 0) after dispatch is flagged.
     assert any("`priorities`" in m and "chunk_step()" in m for m in msgs)
-    # recompile-hazard covers all four jit-key hazard shapes.
+    # recompile-hazard covers all five jit-key hazard shapes.
     prog_msgs = [f.message for f in by_rule["recompile-hazard"]]
     assert any("loop body" in m and "`k`" in m for m in prog_msgs)
     assert any("@jax.jit on a def inside a loop body" in m for m in prog_msgs)
     assert any("one expression" in m for m in prog_msgs)
     assert any("static position 1" in m for m in prog_msgs)
+    assert any("traced body of lax.fori_loop" in m for m in prog_msgs)
     # timeout-discipline reports the literal it saw.
     assert any("600s" in f.message for f in by_rule["timeout-discipline"])
     # observability-drift covers both metric drift and fault-grammar drift.
@@ -391,7 +392,7 @@ def test_json_schema(tmp_path):
     obj = json.loads(out.read_text())
     assert obj["version"] == 1
     assert set(obj["counts"]) == {"files", "findings", "suppressed"}
-    assert obj["counts"]["findings"] == 29
+    assert obj["counts"]["findings"] == 30
     assert obj["counts"]["suppressed"] == 0
     assert sorted(obj["rules"]) == sorted(r.name for r in RULES)
     assert isinstance(obj["elapsed_s"], float)
@@ -622,7 +623,7 @@ def _git(repo, *args):
 
 @pytest.fixture()
 def lint_repo(tmp_path):
-    """A tiny git repo: one clean file, one file carrying the 4 known
+    """A tiny git repo: one clean file, one file carrying the 5 known
     recompile-hazard findings — both committed, so HEAD is the baseline."""
     repo = (tmp_path / "repo").resolve()
     (repo / "replay").mkdir(parents=True)
@@ -646,7 +647,7 @@ def test_changed_only_nothing_changed(lint_repo, capsys):
 
 
 def test_changed_only_scopes_to_the_diff(lint_repo, capsys):
-    # progs.py carries 3 recompile-hazard findings, but only the CLEAN
+    # progs.py carries 5 recompile-hazard findings, but only the CLEAN
     # file changed: the scoped run must not see them.
     donate = lint_repo / "replay" / "donate.py"
     donate.write_text(donate.read_text() + "\n# touched\n",
