@@ -28,6 +28,7 @@ import dataclasses
 import json
 import math
 import os
+import re
 import shutil
 import sys
 import time
@@ -174,9 +175,19 @@ def _prune(directory: str, keep: int, current: int) -> None:
             file=sys.stderr, flush=True,
         )
     doomed = stale_above + (below[: -(keep - 1)] if keep > 1 else below)
+    # Elastic-pod protection: never delete the newest step whose replay
+    # slice set is complete (latest_complete_slice_step) — on a pod whose
+    # membership shrank, that set is the ONLY recoverable copy of the dead
+    # peer's shard, and survivors keep checkpointing learner state past it
+    # (slice sets at newer steps stay incomplete until the peer returns).
+    protected = latest_complete_slice_step(directory)
+    if protected is not None and protected in doomed:
+        doomed = [s for s in doomed if s != protected]
     for old in doomed:
         try:
             shutil.rmtree(os.path.join(directory, f"step_{old}"),
+                          ignore_errors=True)
+            shutil.rmtree(_slice_step_dir(directory, old),
                           ignore_errors=True)
             for side in (f"config_{old}.json", f"manifest_{old}.json"):
                 side_path = os.path.join(directory, side)
@@ -254,6 +265,12 @@ def verify_checkpoint(directory: str, step: int) -> Tuple[bool, str]:
             return False, f"size mismatch {rel}: {got_size} != {size}"
         if got_crc != crc:
             return False, f"digest mismatch {rel}"
+    # Per-slice digests (elastic pod): a torn replay-slice write
+    # quarantines ONLY that slice — the learner-state step above already
+    # verified, and slice adoption has its own fallback chain
+    # (latest_complete_slice_step), so a bad slice must never cost the
+    # whole step.
+    verify_replay_slices(directory, step, quarantine=True)
     return True, "ok"
 
 
@@ -577,6 +594,213 @@ def valid_steps(directory: str, limit: Optional[int] = None):
     return out[-limit:] if limit else out
 
 
+# --- all-writer replay slices (elastic pod; docs/REPLAY_SHARDING.md) ------
+#
+# Multi-host SHARDED replay spans processes, so no single writer can put
+# its contents inside the orbax tree (state_dict raises there by design).
+# Instead EVERY process writes its own slice — the logical ring positions
+# it owns plus the packed rows (and PER priorities) at those positions —
+# into a shared sibling namespace:
+#
+#   directory/replay_slices/step_<N>/slice_<k>_of_<n>.npz   (payload)
+#   directory/replay_slices/step_<N>/slice_<k>_of_<n>.json  (digest sidecar)
+#
+# Filenames are per-writer, so the single-writer-per-file discipline holds
+# on a shared filesystem with zero cross-process coordination; the digest
+# sidecar (size + head/tail crc32, written AFTER the payload's atomic
+# rename) certifies "this slice finished writing". The slice format is
+# position-indexed, so a restore can merge any complete set and re-scatter
+# to a DIFFERENT process count (replay/device.py merge_slice_states +
+# the reshard program) — the wire format is placement-portable like the
+# logical-order state_dict it slices.
+
+SLICE_DIRNAME = "replay_slices"
+_SLICE_RE = re.compile(r"^slice_(\d+)_of_(\d+)\.npz$")
+
+
+def _slice_step_dir(directory: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(directory), SLICE_DIRNAME, f"step_{step}"
+    )
+
+
+def _slice_steps(directory: str):
+    """Step numbers with any slice directory present, ascending."""
+    root = os.path.join(os.path.abspath(directory), SLICE_DIRNAME)
+    if not os.path.isdir(root):
+        return []
+    return sorted(
+        int(name.split("_", 1)[1])
+        for name in os.listdir(root)
+        if name.startswith("step_") and name.split("_", 1)[1].isdigit()
+    )
+
+
+def write_replay_slice(
+    directory: str, step: int, proc: int, nprocs: int,
+    slice_state: Dict[str, Any], fault=None,
+) -> str:
+    """Write this process's replay slice for `step` (atomic tmp+rename),
+    then its digest sidecar. `slice_state` is replay/device.py
+    slice_state_dict() output (positions + rows + ring scalars, PER adds
+    priorities). `fault` is a faults.FaultSite for the chaos harness: a
+    `kill` kind fires before any byte lands (peer lost DURING checkpoint
+    — the slice simply never exists), `ioerror` raises to the caller,
+    and `corrupt` tears the payload AFTER the digest sidecar was
+    computed — the torn-shard-write case restore-time verification must
+    quarantine without failing the step."""
+    torn = False
+    if fault is not None:
+        from distributed_ddpg_tpu.faults import InjectedCorruption
+
+        try:
+            fault.tick()
+        except InjectedCorruption:
+            torn = True
+    root = _slice_step_dir(directory, step)
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, f"slice_{proc}_of_{nprocs}.npz")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **slice_state)
+    os.replace(tmp, path)
+    size, crc = _digest_file(path)
+    jpath = os.path.join(root, f"slice_{proc}_of_{nprocs}.json")
+    jtmp = jpath + ".tmp"
+    with open(jtmp, "w") as f:
+        json.dump(
+            {"step": step, "proc": proc, "nprocs": nprocs,
+             "digest": [size, crc]},
+            f,
+        )
+    os.replace(jtmp, jpath)
+    if torn:
+        # Injected torn write: the digest above covered the intact file,
+        # the payload on disk is now shorter — exactly what a crash
+        # mid-flush past the rename window leaves behind.
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    return path
+
+
+def _verify_slice(root: str, proc: int, nprocs: int) -> Tuple[bool, str]:
+    path = os.path.join(root, f"slice_{proc}_of_{nprocs}.npz")
+    jpath = os.path.join(root, f"slice_{proc}_of_{nprocs}.json")
+    if not os.path.exists(path):
+        return False, "missing slice"
+    if not os.path.exists(jpath):
+        return False, "no digest sidecar (write did not finish)"
+    try:
+        with open(jpath) as f:
+            size, crc = json.load(f)["digest"]
+    except (OSError, json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+        return False, f"unreadable digest sidecar: {e!r}"
+    try:
+        got_size, got_crc = _digest_file(path)
+    except OSError:
+        return False, "unreadable slice"
+    if got_size != size:
+        return False, f"size mismatch: {got_size} != {size}"
+    if got_crc != crc:
+        return False, "digest mismatch"
+    return True, "ok"
+
+
+def slice_status(directory: str, step: int):
+    """-> (complete, nprocs, {proc: (ok, why)}). A step's slice set is
+    COMPLETE when some world size n has all n slices present and
+    digest-valid. `nprocs` is that n (or the largest world size seen when
+    incomplete; None when no slices exist at all)."""
+    root = _slice_step_dir(directory, step)
+    if not os.path.isdir(root):
+        return False, None, {}
+    by_n: Dict[int, set] = {}
+    for name in os.listdir(root):
+        m = _SLICE_RE.match(name)
+        if m:
+            by_n.setdefault(int(m.group(2)), set()).add(int(m.group(1)))
+    if not by_n:
+        return False, None, {}
+    # Prefer a world size whose file set is full; verify digests for it.
+    for n in sorted(by_n, reverse=True):
+        if by_n[n] == set(range(n)):
+            status = {k: _verify_slice(root, k, n) for k in range(n)}
+            complete = all(ok for ok, _ in status.values())
+            return complete, n, status
+    n = max(by_n)
+    status = {k: _verify_slice(root, k, n) for k in sorted(by_n[n])}
+    return False, n, status
+
+
+def verify_replay_slices(directory: str, step: int,
+                         quarantine: bool = True) -> Tuple[bool, int]:
+    """Verify the step's slice set; with `quarantine`, move each
+    digest-failed slice out of the slice namespace (-> .corrupt, the
+    _quarantine_corrupt discipline: payload kept for forensics, the set
+    reads as incomplete afterwards). Returns (complete, nprocs or 0).
+    A torn slice quarantines ONLY itself — the learner-state step stays
+    valid (verify_checkpoint), and adoption falls back to the newest
+    OLDER complete set (latest_complete_slice_step)."""
+    complete, n, status = slice_status(directory, step)
+    if quarantine:
+        root = _slice_step_dir(directory, step)
+        for proc, (ok, why) in status.items():
+            if ok or why == "missing slice":
+                continue
+            src = os.path.join(root, f"slice_{proc}_of_{n}.npz")
+            if not os.path.exists(src):
+                continue
+            try:
+                os.replace(src, src + ".corrupt")
+                print(
+                    f"[checkpoint] quarantined corrupt replay slice "
+                    f"{proc}/{n} at step_{step} ({why}) -> .corrupt; the "
+                    "step's learner state stays valid",
+                    file=sys.stderr, flush=True,
+                )
+            except OSError:
+                pass
+    return complete, (n or 0)
+
+
+def latest_complete_slice_step(
+    directory: str, at_or_below: Optional[int] = None,
+) -> Optional[int]:
+    """Newest step (optionally <= `at_or_below`) whose replay slice set is
+    complete and digest-valid — the adoption input for an elastic
+    restart (train.py): the dead peer's slice comes from its last
+    verified write, so replay may be a few cadences staler than the
+    elected learner step. Returns None when no step qualifies (the
+    exit-76 fallback branch)."""
+    if not directory:
+        return None
+    for s in sorted(_slice_steps(directory), reverse=True):
+        if at_or_below is not None and s > at_or_below:
+            continue
+        complete, _, _ = slice_status(directory, s)
+        if complete:
+            return s
+    return None
+
+
+def load_replay_slices(directory: str, step: int):
+    """Read back the complete slice set at `step` as a list of dicts of
+    host arrays (one per writer, any order — merge is position-driven)."""
+    complete, n, status = slice_status(directory, step)
+    if not complete:
+        bad = {k: why for k, (ok, why) in status.items() if not ok}
+        raise RuntimeError(
+            f"replay slice set at step_{step} is incomplete "
+            f"(world={n}, failures={bad})"
+        )
+    root = _slice_step_dir(directory, step)
+    out = []
+    for k in range(n):
+        with np.load(os.path.join(root, f"slice_{k}_of_{n}.npz")) as z:
+            out.append({key: z[key] for key in z.files})
+    return out
+
+
 def restore(
     directory: str,
     state_template: TrainState,
@@ -682,13 +906,16 @@ def restore(
             template["meta"]["v_bounds"] = np.zeros(2, np.float64)
         if not has_replay and replay is not None:
             # Checkpoints from multi-host SHARDED runs omit replay
-            # contents (no single-writer snapshot spans the shards —
-            # replay/device.py state_dict, docs/REPLAY_SHARDING.md): the
-            # buffer resumes empty and re-warms, loudly.
+            # contents from the orbax tree (no single-writer snapshot
+            # spans the shards — replay/device.py state_dict,
+            # docs/REPLAY_SHARDING.md): the buffer resumes empty here;
+            # the caller may adopt the all-writer slice set afterwards
+            # (latest_complete_slice_step + load_replay_slices).
             template.pop("replay", None)
             print(
                 f"[checkpoint] step_{step} carries no replay contents "
-                "(multi-host sharded writer); the buffer resumes empty",
+                "(multi-host sharded writer); the buffer resumes empty "
+                "unless a verified slice set is adopted",
                 file=sys.stderr, flush=True,
             )
         elif has_replay and replay is None:
@@ -711,6 +938,10 @@ def restore(
     meta = restored.get("meta", {})
     env_steps = int(meta.get("env_steps", 0))
     if meta_out is not None:
+        # Whether the checkpoint's orbax tree carried replay contents —
+        # the slice-adoption gate (train.py adopts the all-writer slice
+        # set only when the tree did NOT restore the buffer).
+        meta_out["ckpt_has_replay"] = bool(has_replay)
         if "v_bounds" in meta:
             vb = np.asarray(meta["v_bounds"], np.float64)
             meta_out["v_bounds"] = (float(vb[0]), float(vb[1]))

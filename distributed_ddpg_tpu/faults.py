@@ -18,9 +18,9 @@ Grammar (';'-separated specs):
 
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
     component := worker | pool | shipper | prefetch | ckpt | transfer | pod
-                 | numeric | serve | devactor
+                 | numeric | serve | devactor | slice
     kind      := crash | crashloop | hang | stall | slow | ioerror | kill
-                 | nan | inf | spike
+                 | nan | inf | spike | corrupt
 
 `at` is 1-based: for `worker` it is the env step inside that worker's
 FIRST incarnation (a respawned worker gets a clean slate — except
@@ -89,6 +89,18 @@ Fault semantics by component:
                              DeviceActorError surfaces to the trainer
     devactor:rollout:slow@K~S the K-th rollout dispatch sleeps S first
                              (throughput-dent flavor; rows still land)
+    slice:<proc>:corrupt@K   process <proc>'s K-th replay-slice write lands
+                             TORN: the digest sidecar records the intact
+                             payload, then the npz is truncated — exactly
+                             the shape of a peer dying mid-write. Slice
+                             verification (checkpoint.verify_replay_slices)
+                             must quarantine that one slice and leave the
+                             step's siblings intact (docs/RESILIENCE.md)
+    slice:<proc>:kill@K      process <proc> SIGKILLs itself at its K-th
+                             replay-slice write, BEFORE any byte lands —
+                             peer-loss-during-checkpoint; the step's slice
+                             set stays incomplete and restore must fall
+                             back to an older complete step (or exit 76)
 
 Numeric `at` ordinals count GUARDED learner steps on a monotonic clock
 (guardrails.GuardState.total) that is deliberately NOT rolled back by the
@@ -117,9 +129,9 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
-              "pod", "numeric", "serve", "devactor")
+              "pod", "numeric", "serve", "devactor", "slice")
 KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill",
-         "nan", "inf", "spike")
+         "nan", "inf", "spike", "corrupt")
 
 # Worker `slow` faults throttle this many consecutive env steps, then lift
 # — bounded so a chaos soak keeps making progress past the fault.
@@ -131,6 +143,10 @@ SLOW_FAULT_STEPS = 200
 _WORKER_KINDS = ("crash", "crashloop", "hang", "stall", "slow")
 _SITE_KINDS = ("crash", "hang", "slow", "ioerror")
 _POD_KINDS = ("kill", "hang")
+# Slice faults target one process's all-writer replay-slice writes
+# (checkpoint.write_replay_slice): `corrupt` tears the payload after the
+# digest landed, `kill` dies before any byte does.
+_SLICE_KINDS = ("corrupt", "kill")
 # Numeric faults are target->kind pairs (each target poisons one specific
 # detector of the guardrails probe): grad->nan, replay->inf, loss->spike.
 _NUMERIC_PAIRS = {"grad": "nan", "replay": "inf", "loss": "spike"}
@@ -147,6 +163,14 @@ class InjectedFault(OSError):
     """A scripted fault from a FaultPlan. Subclasses OSError so recovery
     paths written for real IO failures (checkpoint write retry) treat an
     injected failure exactly like the genuine article."""
+
+
+class InjectedCorruption(InjectedFault):
+    """A scripted torn write: raised by a slice site's tick() and caught
+    INSIDE checkpoint.write_replay_slice, which then truncates the payload
+    it just wrote (after the digest sidecar landed intact). Distinct from
+    plain InjectedFault so only the corruption-aware writer absorbs it —
+    any other site treats it as the IO failure it subclasses."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -241,6 +265,12 @@ class FaultPlan:
         with the (identical-everywhere) beat sequence."""
         return self.site("pod", str(int(process_index)))
 
+    def slice_site(self, process_index: int) -> "FaultSite":
+        """The replay-slice injector for ONE process: ticked once per
+        write_replay_slice call (checkpoint.py), so `@K` is that process's
+        K-th slice write — cadence and emergency writes both count."""
+        return self.site("slice", str(int(process_index)))
+
     def numeric_steps(self) -> Dict[str, Tuple[int, ...]]:
         """Guarded-learner-step ordinals for the IN-PROGRAM numeric faults
         ('grad' -> NaN batch, 'loss' -> 1e6-scaled rewards), consumed at
@@ -326,6 +356,16 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
             int(target)
         except ValueError:
             raise bad("pod target must be an integer process id") from None
+    elif component == "slice":
+        if kind not in _SLICE_KINDS:
+            raise bad(
+                f"kind {kind!r} does not apply to slice "
+                f"(one of {_SLICE_KINDS})"
+            )
+        try:
+            int(target)
+        except ValueError:
+            raise bad("slice target must be an integer process id") from None
     elif component == "numeric":
         if target not in _NUMERIC_PAIRS:
             raise bad(
@@ -387,6 +427,14 @@ class FaultSite:
             self.fired.append(s.describe())
             if s.kind in ("slow", "hang", "stall"):
                 time.sleep(s.duration_s)
+            elif s.kind == "corrupt":
+                # Torn-write request: the slice writer catches this AFTER
+                # persisting the digest sidecar and truncates the payload
+                # (checkpoint.write_replay_slice) — verification, not the
+                # writer, must be what rejects the slice.
+                raise InjectedCorruption(
+                    f"injected {s.describe()} (call #{self._count})"
+                )
             elif s.kind == "kill":
                 # Pod-scoped process death (pod:<proc>:kill@beat): SIGKILL
                 # ourselves — no cleanup, no exception, exactly the shape

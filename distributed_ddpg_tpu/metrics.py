@@ -683,6 +683,23 @@ class PodStats:
       pod_collective_slack_p95_ms deadline headroom at the p95-slowest
                                   collective (deadline - p95 elapsed);
                                   trending toward 0 = deadline too tight
+
+    Elastic-pod events (docs/RESILIENCE.md shrink/grow state machine):
+
+      pod_slices_adopted          replay slice sets adopted at restore
+                                  (all-writer checkpoints)
+      pod_slice_adopted_step      the step the adopted slice set was
+                                  written at (-1 = none; may trail the
+                                  elected resume step — replay is allowed
+                                  to be a few cadences staler)
+      pod_shrinks                 restarts that adopted a slice set from
+                                  a LARGER world (training continues at
+                                  reduced membership -> degraded)
+      pod_grows                   restarts that resharded a smaller
+                                  world's slices back up (rejoin ->
+                                  healthy)
+      pod_state_degraded          1 while the pod trains below the slice
+                                  set's writer count, 0 once grown back
     """
 
     NEAR_MISS_FRAC = 0.8
@@ -694,6 +711,11 @@ class PodStats:
         self.resume_step_elected = -1
         self.beats = 0
         self.near_misses = 0
+        self.slices_adopted = 0
+        self.slice_adopted_step = -1
+        self.shrinks = 0
+        self.grows = 0
+        self.degraded = False
         self._deadline_s = 0.0
         self._elapsed = _Reservoir(
             64, (zlib.crc32(b"pod_collective") ^ seed) & 0x7FFFFFFF
@@ -718,6 +740,32 @@ class PodStats:
         with self._lock:
             self.resume_step_elected = int(step)
 
+    def record_slice_adopted(self, step: int) -> None:
+        with self._lock:
+            self.slices_adopted += 1
+            self.slice_adopted_step = int(step)
+
+    def record_shrink(self) -> None:
+        """Adopted a slice set written by a LARGER world: the pod keeps
+        training at reduced membership in a typed degraded state."""
+        with self._lock:
+            self.shrinks += 1
+            self.degraded = True
+
+    def record_grow(self) -> None:
+        """Resharded a smaller world's slices back up (rejoin): degraded
+        clears — the pod is healthy at its new membership."""
+        with self._lock:
+            self.grows += 1
+            self.degraded = False
+
+    def elastic_events(self) -> int:
+        """Nonzero when any elastic transition happened — the gate for
+        surfacing pod_* fields on runs that shrank to one process
+        (train_jax logs pod fields when is_multi OR this)."""
+        with self._lock:
+            return self.slices_adopted + self.shrinks + self.grows
+
     def note_beat(self) -> None:
         with self._lock:
             self.beats += 1
@@ -738,6 +786,11 @@ class PodStats:
                 "pod_beats": self.beats,
                 "pod_collective_near_misses": self.near_misses,
                 "pod_collective_slack_p95_ms": slack_ms,
+                "pod_slices_adopted": self.slices_adopted,
+                "pod_slice_adopted_step": self.slice_adopted_step,
+                "pod_shrinks": self.shrinks,
+                "pod_grows": self.grows,
+                "pod_state_degraded": int(self.degraded),
             }
 
 

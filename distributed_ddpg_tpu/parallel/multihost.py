@@ -490,6 +490,32 @@ def elect_resume_step(local_steps: Iterable[int], limit: int = 8) -> int:
     return _common_step(gathered)
 
 
+def elect_slice_step(local_step: Optional[int]) -> int:
+    """Coordinated replay-slice adoption election (elastic pod;
+    docs/REPLAY_SHARDING.md all-writer checkpoints): all-gather each
+    process's newest complete slice step
+    (checkpoint.latest_complete_slice_step) and adopt it only when EVERY
+    process sees the SAME step — on a shared checkpoint filesystem that
+    is the common case; under NFS visibility skew or per-host disks a
+    disagreement must resolve to 'nobody adopts' (-1, every buffer
+    resumes empty — also agreed), because a pod where some processes
+    load rows and others don't has forked its data distribution. Rides
+    the uniform int64 transport like every pod gather; single-process
+    returns the local answer directly. ALL processes must call this at
+    the same point (train_jax resume, right after the step election)."""
+    import jax
+    import numpy as np
+
+    local = -1 if local_step is None else int(local_step)
+    if jax.process_count() <= 1:
+        return local
+    gathered = allgather_scalar(
+        np.int64(local), label="slice_step_election"
+    )
+    vals = {int(v) for v in np.asarray(gathered).reshape(-1)}
+    return local if vals == {local} and local >= 0 else -1
+
+
 def process_info() -> dict:
     import jax
 

@@ -302,6 +302,11 @@ class DeviceReplay:
             self._scalar_sharding = scalar_sharding
             self._insert_grouped_cache = {}
             self._insert_replrows_cache = {}
+            # Restore-time reshard programs (elastic pod): land a full
+            # replicated LOGICAL state onto this mesh's owners, whatever
+            # process count wrote it (_get_reshard; docs/REPLAY_SHARDING.md
+            # all-writer checkpoints).
+            self._reshard_cache = {}
 
         # Multi-host ingest (see module docstring): a second compiled insert
         # whose block input is SHARDED over the data axis — each process
@@ -1171,6 +1176,50 @@ class DeviceReplay:
             self._insert_replrows_cache[m] = fn
         return fn
 
+    def _make_reshard_body(self):
+        """Pure restore-time reshard (elastic pod; docs/REPLAY_SHARDING.md
+        all-writer checkpoints): the full LOGICAL ring arrives replicated
+        (merged from a complete slice set, identical on every process),
+        and each shard gathers exactly the positions it owns under THIS
+        mesh's strided map (p % N) into its local run — the placement
+        twin of _make_insert_replrows_body with no ring-pointer state.
+        Because the input is placement-free logical order, the same
+        program lands a slice set written by ANY process count M onto a
+        pod of N processes (the N->M reshard). No collective, no host
+        bytes beyond the replicated feed."""
+        from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+        n, sc = self._n_shards, self._shard_cap
+
+        def body(rows):
+            s = jax.lax.axis_index("data")
+            return rows[s + jnp.arange(sc, dtype=jnp.int32) * n]
+
+        return mesh_lib.shard_map(
+            body, self._mesh,
+            in_specs=(P(None, None),),
+            out_specs=P("data", None),
+        )
+
+    def _get_reshard(self):
+        """Jitted _make_reshard_body — full-capacity logical rows
+        (replicated) -> sharded physical storage. One program per buffer
+        (restore-time only, never on the hot path)."""
+        if not self.sharded:
+            raise ReplayUsageError(
+                "reshard is the sharded-placement restore program; "
+                "replicated buffers load logical state directly"
+            )
+        fn = self._reshard_cache.get("rows")
+        if fn is None:
+            fn = jax.jit(
+                self._make_reshard_body(),
+                in_shardings=(NamedSharding(self._mesh, P(None, None)),),
+                out_shardings=self._storage_sharding,
+            )
+            self._reshard_cache["rows"] = fn
+        return fn
+
     def _get_global_insert_sharded(self, k: int):
         """Compiled multi-host sharded insert for a k-block lockstep beat:
         all-gather the process-major arrival block, compute each gathered
@@ -1339,9 +1388,9 @@ class DeviceReplay:
             if self.sharded and self._procs > 1:
                 raise ReplayUsageError(
                     "sharded replay contents span processes and have no "
-                    "single-writer checkpoint yet; train_jax omits replay "
-                    "from checkpoints in multi-host sharded mode "
-                    "(docs/REPLAY_SHARDING.md)"
+                    "single-writer snapshot; each process checkpoints its "
+                    "own slice instead (slice_state_dict + "
+                    "checkpoint.write_replay_slice; docs/REPLAY_SHARDING.md)"
                 )
             n = len(self)
             storage = np.asarray(jax.device_get(self.storage))
@@ -1356,19 +1405,121 @@ class DeviceReplay:
                 "size": np.asarray(n),
             }
 
+    def slice_state_dict(self):
+        """This process's slice of the logical ring — the all-writer
+        checkpoint payload (checkpoint.write_replay_slice;
+        docs/REPLAY_SHARDING.md). `positions` are the LOGICAL ring indices
+        in [0, size) whose shards this process hosts (strided ownership
+        p % N), ascending; `rows` are the packed rows at those positions.
+        The format is position-indexed rather than shard-indexed, so a
+        restore can merge any complete set and re-scatter to a DIFFERENT
+        process count (merge_slice_states + load_state_dict). A
+        single-process buffer (replicated or sharded) degenerates to one
+        slice covering the whole ring."""
+        with self.dispatch_lock:
+            if not (self.sharded and self._procs > 1):
+                st = self.state_dict()
+                n = int(st["size"])
+                out = {
+                    "positions": np.arange(n, dtype=np.int64),
+                    "rows": np.asarray(st["packed"], np.float32),
+                    "ptr": np.asarray(int(st["ptr"]), np.int64),
+                    "size": np.asarray(n, np.int64),
+                    "capacity": np.asarray(self.capacity, np.int64),
+                }
+                if "priorities" in st:
+                    out["priorities"] = np.asarray(
+                        st["priorities"], np.float32
+                    )
+                    out["max_priority"] = np.asarray(
+                        st["max_priority"], np.float32
+                    )
+                return out
+            n = int(jax.device_get(self.size))
+            ptr = int(jax.device_get(self.ptr))
+            N, sc = self._n_shards, self._shard_cap
+            pos_parts, row_parts = [], []
+            seen = set()
+            for sh in self.storage.addressable_shards:
+                # Model-axis replicas repeat the same data shard; dedupe
+                # by the shard's row offset into the global array.
+                start = sh.index[0].start or 0
+                if start in seen:
+                    continue
+                seen.add(start)
+                sid = start // sc
+                cnt = (n - sid + N - 1) // N if n > sid else 0
+                if cnt <= 0:
+                    continue
+                # Local slot j of shard sid holds logical sid + j*N.
+                pos_parts.append(
+                    sid + np.arange(cnt, dtype=np.int64) * N
+                )
+                row_parts.append(
+                    np.asarray(np.asarray(sh.data)[:cnt], np.float32)
+                )
+            if pos_parts:
+                positions = np.concatenate(pos_parts)
+                rows = np.concatenate(row_parts)
+                order = np.argsort(positions, kind="stable")
+                positions = positions[order]
+                rows = np.ascontiguousarray(rows[order])
+            else:
+                positions = np.zeros((0,), np.int64)
+                rows = np.zeros((0, self.width), np.float32)
+            return {
+                "positions": positions,
+                "rows": rows,
+                "ptr": np.asarray(ptr, np.int64),
+                "size": np.asarray(n, np.int64),
+                "capacity": np.asarray(self.capacity, np.int64),
+            }
+
+    def _replicated_scalar(self, v: int):
+        out = jnp.asarray(int(v), jnp.int32)
+        if self._mesh is not None:
+            out = jax.device_put(out, NamedSharding(self._mesh, P()))
+        return out
+
+    def _load_state_multihost(self, state) -> None:
+        """Multi-host sharded restore (elastic pod): every process holds
+        the SAME full logical state (merged from a verified slice set on
+        the shared checkpoint namespace), feeds it replicated — the
+        module-docstring device_put discipline: identical global value on
+        every process — and the reshard program scatters each shard's
+        owned positions locally. This is the N->M reshard: the slice
+        set's writer count never appears here, only the logical order."""
+        n = int(state["size"])
+        with self.dispatch_lock:
+            full = np.zeros((self.capacity, self.width), np.float32)
+            full[:n] = np.asarray(state["packed"], np.float32)
+            rows = jax.device_put(
+                jnp.asarray(full), NamedSharding(self._mesh, P(None, None))
+            )
+            self.storage = self._get_reshard()(rows)
+            self.ptr = self._replicated_scalar(
+                int(state["ptr"]) % self.capacity
+            )
+            self.size = self._replicated_scalar(n)
+            if self._track_sources:
+                self._source_map.fill(-1)
+                self._src_fifo.clear()
+                self._host_ptr = int(state["ptr"]) % self.capacity
+
     def load_state_dict(self, state) -> None:
         n = int(state["size"])
         if n > self.capacity:
             raise ValueError(f"checkpointed size {n} exceeds capacity {self.capacity}")
         if self.sharded and self._procs > 1:
-            raise ReplayUsageError(
-                "sharded replay contents cannot be restored multi-host "
-                "(no single-writer checkpoint; docs/REPLAY_SHARDING.md)"
-            )
+            self._load_state_multihost(state)
+            return
         with self.dispatch_lock:
             if self.sharded:
+                # np.array: device_get hands back a READ-ONLY buffer, and
+                # the logical permutation is a no-op (same buffer) when
+                # there is a single shard.
                 storage = self._to_logical_rows(
-                    np.asarray(jax.device_get(self.storage))
+                    np.array(jax.device_get(self.storage))
                 )
                 storage[:n] = state["packed"]
                 storage = self._to_physical_rows(storage)
@@ -1393,6 +1544,94 @@ class DeviceReplay:
                 self._source_map.fill(-1)
                 self._src_fifo.clear()
                 self._host_ptr = int(state["ptr"]) % self.capacity
+
+
+def merge_slice_states(slices):
+    """Merge a complete all-writer slice set (checkpoint.load_replay_slices
+    output, any order) back into ONE logical-order state_dict —
+    load_state_dict's wire format, placement-portable by construction.
+    Validates that every slice agrees on the ring scalars and that the
+    positions tile [0, size) exactly once: a hole or an overlap means the
+    set mixes worlds or writers, and silently loading it would corrupt the
+    data distribution the learner resumes on."""
+    if not slices:
+        raise ReplayUsageError("merge_slice_states: empty slice set")
+    size = int(slices[0]["size"])
+    ptr = int(slices[0]["ptr"])
+    cap = int(slices[0]["capacity"])
+    for s in slices:
+        got = (int(s["size"]), int(s["ptr"]), int(s["capacity"]))
+        if got != (size, ptr, cap):
+            raise ReplayUsageError(
+                f"slice set disagrees on ring scalars: {got} != "
+                f"{(size, ptr, cap)} (slices from different steps or runs)"
+            )
+    width = int(np.asarray(slices[0]["rows"]).shape[-1])
+    packed = np.zeros((size, width), np.float32)
+    covered = np.zeros(size, bool)
+    has_prio = any("priorities" in s for s in slices)
+    prios = np.zeros(size, np.float32) if has_prio else None
+    maxp = 1.0
+    for s in slices:
+        pos = np.asarray(s["positions"], np.int64)
+        if pos.size == 0:
+            continue
+        if pos.min() < 0 or pos.max() >= size:
+            raise ReplayUsageError(
+                f"slice positions out of range [0, {size}): "
+                f"[{pos.min()}, {pos.max()}]"
+            )
+        if covered[pos].any():
+            raise ReplayUsageError(
+                "overlapping slice positions (two writers claim the same "
+                "ring rows — mixed slice sets)"
+            )
+        packed[pos] = np.asarray(s["rows"], np.float32)
+        covered[pos] = True
+        if has_prio:
+            prios[pos] = np.asarray(s["priorities"], np.float32)
+            maxp = max(maxp, float(s["max_priority"]))
+    if not covered.all():
+        raise ReplayUsageError(
+            f"slice set does not cover the ring: {int((~covered).sum())} "
+            f"of {size} positions missing"
+        )
+    out = {
+        "packed": packed,
+        "ptr": np.asarray(ptr),
+        "size": np.asarray(size),
+    }
+    if has_prio:
+        out["priorities"] = prios
+        out["max_priority"] = np.asarray(maxp, np.float32)
+    return out
+
+
+def split_slice_state(state, nslices: int, capacity: int):
+    """Partition a full logical state_dict into `nslices` position-strided
+    slices (position p -> slice p % n, the ownership map an n-process
+    sharded pod would have written) — the inverse of merge_slice_states,
+    for the reshard-matrix tests and offline resharding tools."""
+    n = int(state["size"])
+    out = []
+    for k in range(nslices):
+        pos = np.arange(k, n, nslices, dtype=np.int64)
+        sl = {
+            "positions": pos,
+            "rows": np.asarray(state["packed"], np.float32)[pos],
+            "ptr": np.asarray(int(state["ptr"]), np.int64),
+            "size": np.asarray(n, np.int64),
+            "capacity": np.asarray(int(capacity), np.int64),
+        }
+        if "priorities" in state:
+            sl["priorities"] = np.asarray(
+                state["priorities"], np.float32
+            )[pos]
+            sl["max_priority"] = np.asarray(
+                state["max_priority"], np.float32
+            )
+        out.append(sl)
+    return out
 
 
 def draw_per_indices(key, priorities, size, shape, beta):
@@ -1656,6 +1895,36 @@ class DevicePrioritizedReplay(DeviceReplay):
 
     # --- checkpoint support ---
 
+    def _get_prio_reshard(self):
+        """Jitted restore-time reshard for the priority vector — the 1-D
+        twin of _get_reshard, sharing the strided ownership map so the
+        priorities can never land on a different owner than their rows
+        (the rebuild half of 'priority-tree rebuild': shard-local
+        cumsums are recomputed from these slots at the next draw)."""
+        if not self.sharded:
+            raise ReplayUsageError(
+                "prio reshard is the sharded-placement restore program"
+            )
+        fn = self._reshard_cache.get("prio")
+        if fn is None:
+            from distributed_ddpg_tpu.parallel import mesh as mesh_lib
+
+            n, sc = self._n_shards, self._shard_cap
+
+            def body(prios):
+                s = jax.lax.axis_index("data")
+                return prios[s + jnp.arange(sc, dtype=jnp.int32) * n]
+
+            fn = jax.jit(
+                mesh_lib.shard_map(
+                    body, self._mesh, in_specs=(P(None),), out_specs=P("data")
+                ),
+                in_shardings=(NamedSharding(self._mesh, P(None)),),
+                out_shardings=self._stamp_shardings[0],
+            )
+            self._reshard_cache["prio"] = fn
+        return fn
+
     def state_dict(self):
         with self.dispatch_lock:
             state = super().state_dict()
@@ -1669,28 +1938,75 @@ class DevicePrioritizedReplay(DeviceReplay):
             )
             return state
 
+    def slice_state_dict(self):
+        with self.dispatch_lock:
+            out = super().slice_state_dict()
+            if not (self.sharded and self._procs > 1):
+                return out  # state_dict already carried the priorities
+            n = int(out["size"])
+            N, sc = self._n_shards, self._shard_cap
+            # Priorities share the rows' strided owner map, so the slots
+            # backing out["positions"] live in this process's priority
+            # shards; index them through a position-keyed scratch vector
+            # to reuse the base class's position ordering.
+            scratch = np.zeros(self.capacity, np.float32)
+            seen = set()
+            for sh in self.priorities.addressable_shards:
+                start = sh.index[0].start or 0
+                if start in seen:
+                    continue
+                seen.add(start)
+                sid = start // sc
+                cnt = (n - sid + N - 1) // N if n > sid else 0
+                if cnt <= 0:
+                    continue
+                scratch[sid + np.arange(cnt, dtype=np.int64) * N] = (
+                    np.asarray(sh.data)[:cnt]
+                )
+            out["priorities"] = scratch[out["positions"]]
+            out["max_priority"] = np.asarray(
+                float(jax.device_get(self.max_priority)), np.float32
+            )
+            return out
+
     def load_state_dict(self, state) -> None:
         with self.dispatch_lock:
             super().load_state_dict(state)
-            if "priorities" in state:
-                n = int(state["size"])
-                prios = np.array(jax.device_get(self.priorities))
-                if self.sharded:
-                    prios = self._to_logical_rows(prios)
-                prios[:n] = state["priorities"]
-                if self.sharded:
-                    prios = self._to_physical_rows(prios)
-                vec_sharding = self._stamp_shardings[0]
-                scalar = (
-                    NamedSharding(self._mesh, P()) if self._mesh is not None else None
+            if "priorities" not in state:
+                return
+            n = int(state["size"])
+            if self.sharded and self._procs > 1:
+                # Elastic restore (the _load_state_multihost twin): feed
+                # the full logical priority vector replicated, scatter
+                # each shard's owned slots locally.
+                full = np.zeros((self.capacity,), np.float32)
+                full[:n] = np.asarray(state["priorities"], np.float32)
+                rep = jax.device_put(
+                    jnp.asarray(full), NamedSharding(self._mesh, P(None))
                 )
-                self.priorities = jnp.asarray(prios)
-                self.max_priority = jnp.asarray(
-                    float(state["max_priority"]), jnp.float32
+                self.priorities = self._get_prio_reshard()(rep)
+                self.max_priority = jax.device_put(
+                    jnp.asarray(float(state["max_priority"]), jnp.float32),
+                    self._stamp_shardings[1],
                 )
-                if vec_sharding is not None:
-                    self.priorities = jax.device_put(self.priorities, vec_sharding)
-                    self.max_priority = jax.device_put(self.max_priority, scalar)
+                return
+            prios = np.array(jax.device_get(self.priorities))
+            if self.sharded:
+                prios = self._to_logical_rows(prios)
+            prios[:n] = state["priorities"]
+            if self.sharded:
+                prios = self._to_physical_rows(prios)
+            vec_sharding = self._stamp_shardings[0]
+            scalar = (
+                NamedSharding(self._mesh, P()) if self._mesh is not None else None
+            )
+            self.priorities = jnp.asarray(prios)
+            self.max_priority = jnp.asarray(
+                float(state["max_priority"]), jnp.float32
+            )
+            if vec_sharding is not None:
+                self.priorities = jax.device_put(self.priorities, vec_sharding)
+                self.max_priority = jax.device_put(self.max_priority, scalar)
 
 
 # ---------------------------------------------------------------------------
@@ -1760,6 +2076,31 @@ def program_specs():
             r._get_stamp(M), (r.priorities, r.max_priority, r.ptr), (0,)
         )
 
+    def reshard_sharded():
+        # The elastic-pod restore scatter (docs/REPLAY_SHARDING.md
+        # all-writer checkpoints): full logical ring replicated -> each
+        # shard's owned positions. Not donated — restore-time only, and
+        # the replicated input never aliases the sharded output.
+        r = DeviceReplay(
+            64, 3, 1, mesh=probe_mesh(), block_size=M, async_ship=False,
+            replay_sharding="sharded",
+        )
+        rows = jax.device_put(
+            np.zeros((64, r.width), np.float32),
+            NamedSharding(r._mesh, P(None, None)),
+        )
+        return BuiltProgram(r._get_reshard(), (rows,), ())
+
+    def per_reshard_sharded():
+        r = DevicePrioritizedReplay(
+            64, 3, 1, mesh=probe_mesh(), block_size=M, async_ship=False,
+            replay_sharding="sharded",
+        )
+        prios = jax.device_put(
+            np.zeros((64,), np.float32), NamedSharding(r._mesh, P(None))
+        )
+        return BuiltProgram(r._get_prio_reshard(), (prios,), ())
+
     return [
         ProgramSpec("replay.insert", OWNER, insert),
         ProgramSpec("replay.insert.sharded", OWNER, insert_sharded),
@@ -1768,4 +2109,6 @@ def program_specs():
         ),
         ProgramSpec("replay.stamp", OWNER, stamp),
         ProgramSpec("replay.stamp.sharded", OWNER, stamp_sharded),
+        ProgramSpec("replay.reshard.sharded", OWNER, reshard_sharded),
+        ProgramSpec("replay.per.reshard.sharded", OWNER, per_reshard_sharded),
     ]

@@ -91,5 +91,13 @@ class PrioritizedReplay(UniformReplay):
     def load_state_dict(self, state) -> None:
         super().load_state_dict(state)
         if "priorities" in state:
-            self._tree.set(np.arange(self._size), state["priorities"])
+            # Full tree REBUILD, not an in-place overlay: a restore to a
+            # smaller fill than the live buffer's (guardrail rollback, or
+            # an elastic-pod slice adoption staler than the ring —
+            # docs/REPLAY_SHARDING.md) must zero the mass at every slot
+            # beyond the restored size, or stratified_sample would keep
+            # drawing rows the restored state never contained.
+            prios = np.zeros(self.capacity, np.float64)
+            prios[: self._size] = state["priorities"]
+            self._tree.set(np.arange(self.capacity), prios)
             self._max_priority = float(state["max_priority"])

@@ -179,6 +179,15 @@ POD_KEYS = (
     "pod_beats",
     "pod_collective_near_misses",
     "pod_collective_slack_p95_ms",
+    # Elastic-pod events (docs/RESILIENCE.md shrink/grow state machine):
+    # slice adoptions, membership transitions, and the typed degraded
+    # state — also present on single-process runs that adopted a larger
+    # world's slice set (the shrink-to-one case).
+    "pod_slices_adopted",
+    "pod_slice_adopted_step",
+    "pod_shrinks",
+    "pod_grows",
+    "pod_state_degraded",
 )
 
 # Numerical-health counters (metrics.GuardrailStats; docs/RESILIENCE.md
@@ -485,6 +494,22 @@ def render_summary(digest: Dict[str, Any]) -> str:
             ["field", "last"],
             [[k, v["last"]] for k, v in pod.items()],
         ))
+        # Elastic transitions get a one-line verdict above the raw
+        # counters: shrink/grow restarts are the record that matters on
+        # a membership-change run (docs/RESILIENCE.md state machine).
+        def _last(k):
+            return pod.get(k, {}).get("last", 0) or 0
+
+        if _last("pod_shrinks") or _last("pod_grows") or _last(
+            "pod_slices_adopted"
+        ):
+            state = "DEGRADED" if _last("pod_state_degraded") else "healthy"
+            out.append(
+                f"   elastic: {int(_last('pod_slices_adopted'))} slice "
+                f"adoption(s) (step {int(_last('pod_slice_adopted_step'))}), "
+                f"{int(_last('pod_shrinks'))} shrink(s), "
+                f"{int(_last('pod_grows'))} grow(s) -> {state}"
+            )
     if digest.get("guardrail"):
         g = digest["guardrail"]
         out.append("\n-- numerical health (docs/RESILIENCE.md; guardrails)")
@@ -620,8 +645,8 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
             lower_better=("bytes_per_row" in key or "_ms" in key
                           or "p95" in key or "p50" in key))
     for key in sorted(set(a.get("pod", {})) | set(b.get("pod", {}))):
-        if key == "pod_resume_step_elected":
-            continue  # an elected step is context, not a metric to delta
+        if key in ("pod_resume_step_elected", "pod_slice_adopted_step"):
+            continue  # elected/adopted steps are context, not metrics to delta
         pa = a.get("pod", {}).get(key, {})
         pb = b.get("pod", {}).get(key, {})
         add(key, pa.get("last"), pb.get("last"),

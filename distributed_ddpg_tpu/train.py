@@ -63,6 +63,17 @@ EXIT_POD_DEGRADED = 76
 # guardrail_* counters in the final JSONL record and the last retained
 # (pre-divergence) checkpoint rather than blindly relaunching.
 EXIT_NUMERIC = 77
+# Elastic-shrink-ready exit (docs/RESILIENCE.md shrink/grow state
+# machine): a pod peer was lost AND a complete, digest-verified replay
+# slice set exists under checkpoint_dir (all-writer slices,
+# docs/REPLAY_SHARDING.md) — the dead peer's experience is recoverable
+# from its last verified write. The driver may relaunch at ANY process
+# count M (including N-1, without the lost host): the resume election
+# plus slice adoption reshards replay to M and the run continues in a
+# typed `degraded` state (pod_state_degraded) until a grow restores full
+# strength. 76 remains the fallback when no verified slice set exists
+# (relaunch the whole pod; replay re-warms).
+EXIT_POD_SHRINK = 78
 
 # Shutdown reap bound for the async eval thread: evals run whole episodes,
 # so teardown grants them real time to finish, but a wedged env must not
@@ -530,6 +541,18 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     # call short-circuits to a direct call (zero overhead).
     pod_stats = PodStats(seed=config.seed)
     pod_lost: list = [None]
+    # Shrink-ready flag (EXIT_POD_SHRINK=78): set on a pod abort when a
+    # complete replay slice set survives under checkpoint_dir — the
+    # driver may relaunch SMALLER instead of waiting for the lost host.
+    pod_shrink_ready = [False]
+
+    def _slices_adoptable() -> bool:
+        return bool(
+            config.checkpoint_dir
+            and config.replay_sharding == "sharded"
+            and ckpt_lib.latest_complete_slice_step(config.checkpoint_dir)
+            is not None
+        )
 
     def _pod_degraded_early(e) -> Dict[str, float]:
         """Peer loss BEFORE the training stack exists (startup barrier /
@@ -539,9 +562,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         would misread as 'crash: diagnose' (docs/RESILIENCE.md)."""
         pod_lost[0] = e
         pod_stats.record_abort()
+        # A prior incarnation may have left an adoptable slice set: a
+        # bootstrap loss is still shrink-recoverable then (exit 78).
+        pod_shrink_ready[0] = _slices_adoptable()
         print(
             f"[train] pod peer lost during pod bootstrap: {e}; exiting "
-            f"{EXIT_POD_DEGRADED}",
+            f"{EXIT_POD_SHRINK if pod_shrink_ready[0] else EXIT_POD_DEGRADED}",
             file=sys.stderr, flush=True,
         )
         return {
@@ -551,6 +577,7 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             "param_checksum": 0.0,
             "preempted": False,
             "pod_degraded": True,
+            "pod_shrink_ready": pod_shrink_ready[0],
             **pod_stats.snapshot(),
         }
 
@@ -753,23 +780,55 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     else:
         device_replay = None
     replay = None if use_device_replay else make_replay(config, spec.obs_dim, spec.act_dim)
-    # Checkpointable replay object. Multi-host SHARDED replay spans
-    # processes — no single writer can snapshot it — so its contents are
-    # omitted from checkpoints (docs/REPLAY_SHARDING.md): learner state,
-    # meta, and the emergency/election contract (exit 76) are unchanged,
-    # and a resumed run re-warms the ring.
+    # Checkpointable replay object. SHARDED replay spans processes — no
+    # single writer can snapshot a multi-host ring — so its contents are
+    # omitted from checkpoints' learner tree and persisted instead as
+    # ALL-WRITER slices (docs/REPLAY_SHARDING.md): every shard owner
+    # writes its position-indexed slice + digest sidecar next to the
+    # checkpoint at the same cadence step, and a restore at ANY process
+    # count M merges a verified complete set and reshards it to M
+    # (replay/device.py merge_slice_states). Single-process sharded runs
+    # take the same path so the wire format never depends on the process
+    # count — the elastic shrink/grow contract (docs/RESILIENCE.md).
     sharded_multi = is_multi and config.replay_sharding == "sharded"
-    if sharded_multi and jax.process_index() == 0:
+    slice_writer = use_device_replay and config.replay_sharding == "sharded"
+    slice_fault = (
+        fault_plan.slice_site(jax.process_index()) if fault_plan else None
+    )
+    if slice_writer and jax.process_index() == 0:
         print(
-            "[replay] multi-host sharded mode: replay contents are "
-            "omitted from checkpoints (docs/REPLAY_SHARDING.md)",
+            "[replay] sharded mode: replay contents are "
+            "omitted from checkpoints' learner tree; every process "
+            "writes its replay slice (docs/REPLAY_SHARDING.md)",
             file=sys.stderr, flush=True,
         )
 
     def ckpt_replay():
-        if sharded_multi:
+        if slice_writer:
             return None
         return device_replay if use_device_replay else replay
+
+    def write_replay_slices(step: int) -> None:
+        """All-writer replay persistence: this process's slice of the
+        sharded ring lands next to the learner checkpoint (atomic write +
+        digest sidecar — checkpoint.write_replay_slice). A failed slice
+        write costs this step's slice-set completeness, never the run:
+        adoption falls back to the newest older complete set."""
+        if not (slice_writer and config.checkpoint_dir
+                and device_replay is not None):
+            return
+        try:
+            ckpt_lib.write_replay_slice(
+                config.checkpoint_dir, step,
+                jax.process_index(), jax.process_count(),
+                device_replay.slice_state_dict(), fault=slice_fault,
+            )
+        except Exception as e:
+            print(
+                f"[pod] replay slice write at step {step} failed "
+                f"({e!r}); the step's slice set stays incomplete",
+                file=sys.stderr, flush=True,
+            )
     if config.strict_sync:
         # Lockstep debug mode (config.strict_sync): inline deterministic
         # actors — same surface, no processes, no races to win.
@@ -881,6 +940,83 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             f"resumed from {resume_dir} at learner step {step}, "
             f"env step {env_steps_offset}"
         )
+
+    # --- replay slice adoption (elastic shrink/grow; docs/RESILIENCE.md
+    # state machine, docs/REPLAY_SHARDING.md all-writer format) ---
+    # A sharded-replay resume restored NO replay through the learner tree
+    # (ckpt_replay() is None); the experience lives in the all-writer
+    # slice sets instead. Adopt the newest complete, digest-verified set
+    # at or below the restored learner step — possibly written by a
+    # DIFFERENT process count n_prev: merge is position-driven, the load
+    # reshards to today's count M. M < n_prev is a SHRINK (a peer's last
+    # verified slice is adopted by the survivors; the run continues
+    # degraded), M > n_prev is a GROW back toward full strength. The
+    # election keeps adoption pod-atomic: either every process adopts the
+    # same step or nobody does (a forked replay distribution is worse
+    # than an empty one).
+    if (
+        do_resume
+        and slice_writer
+        and device_replay is not None
+        and not ckpt_meta.get("ckpt_has_replay")
+    ):
+        sstep = ckpt_lib.latest_complete_slice_step(
+            config.checkpoint_dir, at_or_below=learn_steps
+        )
+        if is_multi:
+            try:
+                elected_slice = multihost.elect_slice_step(sstep)
+            except multihost.PodPeerLost as e:
+                if prev_sigterm is not None:
+                    try:
+                        signal.signal(signal.SIGTERM, prev_sigterm)
+                    except (ValueError, TypeError):
+                        pass
+                device_replay.close()
+                if transfer_sched is not None:
+                    transfer_sched.close()
+                multihost.configure_pod(0.0)
+                return _pod_degraded_early(e)
+            sstep = elected_slice if elected_slice >= 0 else None
+        if sstep is not None:
+            from distributed_ddpg_tpu.replay.device import merge_slice_states
+
+            slices = ckpt_lib.load_replay_slices(
+                config.checkpoint_dir, sstep
+            )
+            device_replay.load_state_dict(merge_slice_states(slices))
+            n_prev = len(slices)
+            nprocs = jax.process_count()
+            pod_stats.record_slice_adopted(sstep)
+            trace.instant("pod_slice_adopted", step=sstep)
+            print(
+                f"[pod] adopted replay slices from step {sstep} "
+                f"(written by {n_prev} process(es), resharded to "
+                f"{nprocs})",
+                file=sys.stderr, flush=True,
+            )
+            if nprocs < n_prev:
+                pod_stats.record_shrink()
+                print(
+                    f"[pod] SHRINK: running at {nprocs}/{n_prev} "
+                    "processes with the lost peer's replay adopted — "
+                    "state degraded until a grow (docs/RESILIENCE.md)",
+                    file=sys.stderr, flush=True,
+                )
+            elif nprocs > n_prev:
+                pod_stats.record_grow()
+                print(
+                    f"[pod] GROW: resharded {n_prev}-writer replay to "
+                    f"{nprocs} processes — state healthy",
+                    file=sys.stderr, flush=True,
+                )
+        else:
+            print(
+                "[pod] no verified replay slice set to adopt at or below "
+                f"step {learn_steps}; the buffer resumes empty "
+                "(docs/REPLAY_SHARDING.md)",
+                file=sys.stderr, flush=True,
+            )
 
     # --- on-device vectorized actors (actors/device_pool.py;
     # docs/DEVICE_ACTORS.md) ---
@@ -1148,8 +1284,14 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         pod rows) for every train/final record on multi-process runs —
         peer losses, coordinated aborts, the elected resume step, and the
         collective-deadline near-miss/slack telemetry. Single-process
-        records stay clean."""
-        return pod_stats.snapshot() if is_multi else {}
+        records stay clean — EXCEPT when elastic events (slice adoption,
+        shrink/grow) happened: a pod shrunk to one process must still
+        surface its degraded state (docs/RESILIENCE.md)."""
+        return (
+            pod_stats.snapshot()
+            if is_multi or pod_stats.elastic_events()
+            else {}
+        )
 
     def guardrail_fields() -> Dict[str, int]:
         """guardrail_* numerical-health counters (metrics.GuardrailStats;
@@ -1810,32 +1952,38 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         if (
             config.checkpoint_dir
             and learn_steps - last_ckpt >= config.checkpoint_every
-            # Learner state + device replay are replicated across processes,
-            # so one writer suffices (and shared-FS writes must not collide).
-            and jax.process_index() == 0
         ):
-            # Async: only the HBM->host snapshot happens here; the disk
-            # write runs on the saver's thread (checkpoint.py AsyncSaver).
             with phases.phase("ckpt"):
-                saver.save_async(
-                    config.checkpoint_dir, learn_steps, learner.state,
-                    ckpt_replay(), config,
-                    env_steps=env_steps(),
-                    devactor_state=(
-                        device_pool.carry_state_dict()
-                        if device_pool is not None
-                        else None
-                    ),
-                    v_bounds=(
-                        (learner.config.v_min, learner.config.v_max)
-                        if config.distributional and config.v_support_auto
-                        else None
-                    ),
-                    keep=config.checkpoint_keep,
-                    retries=config.ckpt_write_retries,
-                    backoff_s=config.ckpt_retry_backoff_s,
-                    fault=ckpt_fault,
-                )
+                # Learner state is replicated across processes, so ONE
+                # writer suffices for the orbax tree (and shared-FS
+                # writes must not collide). Async: only the HBM->host
+                # snapshot happens here; the disk write runs on the
+                # saver's thread (checkpoint.py AsyncSaver).
+                if jax.process_index() == 0:
+                    saver.save_async(
+                        config.checkpoint_dir, learn_steps, learner.state,
+                        ckpt_replay(), config,
+                        env_steps=env_steps(),
+                        devactor_state=(
+                            device_pool.carry_state_dict()
+                            if device_pool is not None
+                            else None
+                        ),
+                        v_bounds=(
+                            (learner.config.v_min, learner.config.v_max)
+                            if config.distributional and config.v_support_auto
+                            else None
+                        ),
+                        keep=config.checkpoint_keep,
+                        retries=config.ckpt_write_retries,
+                        backoff_s=config.ckpt_retry_backoff_s,
+                        fault=ckpt_fault,
+                    )
+                # Sharded replay is NOT replicated: every shard owner
+                # writes its slice at the same cadence step (all-writer,
+                # docs/REPLAY_SHARDING.md). learn_steps is lockstep-
+                # identical, so the slice sets line up by construction.
+                write_replay_slices(learn_steps)
             last_ckpt = learn_steps
 
     def _host_per_update(out, indices) -> None:
@@ -1874,6 +2022,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         my_dir = (
             config.checkpoint_dir if jax.process_index() == 0 else pod_ckpt_dir
         )
+        # Sharded replay: every process (not just the learner-tree
+        # writer) persists its slice — into the SHARED dir, where the
+        # per-proc filenames cannot collide. On a pod abort the dead
+        # peer's slice is of course absent, so THIS step's set stays
+        # incomplete; adoption falls back to the last cadence step where
+        # all writers landed (docs/REPLAY_SHARDING.md).
+        write_replay_slices(learn_steps)
         if config.checkpoint_dir and i_write:
             if ckpt_lib.latest_step(my_dir) != learn_steps:
                 with phases.phase("ckpt"):
@@ -2220,6 +2375,19 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         if transfer_sched is not None:
             transfer_sched.close()
         _emergency_checkpoint()
+        # Shrink-readiness (docs/RESILIENCE.md state machine): with a
+        # complete, digest-verified slice set on disk the dead peer's
+        # replay is recoverable — exit EXIT_POD_SHRINK (78) so the
+        # driver knows it may relaunch at N-1 instead of waiting for
+        # the lost host. No set -> the existing 76 contract.
+        pod_shrink_ready[0] = _slices_adoptable()
+        if pod_shrink_ready[0]:
+            print(
+                f"[train] complete replay slice set on disk — "
+                f"shrink-ready, exiting {EXIT_POD_SHRINK} (relaunch at "
+                "any process count adopts it)",
+                file=sys.stderr, flush=True,
+            )
     finally:
         if prev_sigterm is not None:
             try:
@@ -2337,6 +2505,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         # documented exit (76 vs 75) — report exactly one of the two.
         "preempted": preempt.is_set() and pod_lost[0] is None,
         "pod_degraded": pod_lost[0] is not None,
+        # Elastic-shrink readiness: a pod abort with a complete replay
+        # slice set on disk exits 78 (relaunch smaller adopts it), 76
+        # otherwise (docs/RESILIENCE.md).
+        "pod_shrink_ready": bool(pod_shrink_ready[0]),
         # Numeric-health abort (EXIT_NUMERIC=77): guardrails exhausted the
         # rollback budget or had nothing to restore.
         "numeric_failed": numeric_failed[0],
@@ -2352,8 +2524,9 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     }
 
 
-def pod_degraded_exit(linger_s: float = 10.0) -> None:
-    """Exit EXIT_POD_DEGRADED the SAFE way after a coordinated pod abort
+def pod_degraded_exit(linger_s: float = 10.0, code: int = EXIT_POD_DEGRADED) -> None:
+    """Exit `code` (EXIT_POD_DEGRADED, or EXIT_POD_SHRINK when the run
+    reported pod_shrink_ready) the SAFE way after a coordinated pod abort
     (train_jax returned pod_degraded=True; emergency checkpoint and logs
     already landed).
 
@@ -2377,7 +2550,7 @@ def pod_degraded_exit(linger_s: float = 10.0) -> None:
         pass
     sys.stdout.flush()
     sys.stderr.flush()
-    os._exit(EXIT_POD_DEGRADED)
+    os._exit(code)
 
 
 def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None) -> float:
@@ -2403,7 +2576,13 @@ def main(argv=None) -> None:
     summary = train(config)
     print({k: round(v, 3) if isinstance(v, float) else v for k, v in summary.items()})
     if summary.get("pod_degraded"):
-        pod_degraded_exit()
+        pod_degraded_exit(
+            code=(
+                EXIT_POD_SHRINK
+                if summary.get("pod_shrink_ready")
+                else EXIT_POD_DEGRADED
+            )
+        )
     if summary.get("numeric_failed"):
         # Documented numeric-health abort: the guardrails could not repair
         # a sustained divergence. Distinct from 75/76 (those are "relaunch

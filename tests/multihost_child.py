@@ -127,9 +127,12 @@ def run_pod_train(pid: int, tag: str) -> None:
                           child-flake note), and THIS harness is pinning
                           the pod-abort contract, not the overlap.
 
-    Prints 'PODRESULT <tag> steps=<n> degraded=<0|1> elected=<step>' and
-    exits with train.py's documented code (76 on pod degradation, 75 on
-    preemption, 0 clean) so the parent asserts the REAL contract."""
+    Prints 'PODRESULT <tag> steps=<n> degraded=<0|1> elected=<step>
+    adopted=<n> shrinks=<n> grows=<n> shrinkready=<0|1>' and exits with
+    train.py's documented code (78 on pod degradation with a complete
+    replay slice set on disk — relaunch-smaller-ready; 76 on pod
+    degradation without one; 75 on preemption, 0 clean) so the parent
+    asserts the REAL contract."""
     import tempfile
 
     from distributed_ddpg_tpu.config import DDPGConfig
@@ -188,15 +191,30 @@ def run_pod_train(pid: int, tag: str) -> None:
     print(
         f"PODRESULT {tag} steps={out['learner_steps']} "
         f"degraded={int(bool(out.get('pod_degraded')))} "
-        f"elected={out.get('pod_resume_step_elected', -1)}",
+        f"elected={out.get('pod_resume_step_elected', -1)} "
+        f"adopted={out.get('pod_slices_adopted', 0)} "
+        f"shrinks={out.get('pod_shrinks', 0)} "
+        f"grows={out.get('pod_grows', 0)} "
+        f"shrinkready={int(bool(out.get('pod_shrink_ready')))}",
         flush=True,
     )
     if out.get("pod_degraded"):
         # The documented exit discipline (leader linger + os._exit) —
-        # the same call train.main() makes.
-        from distributed_ddpg_tpu.train import pod_degraded_exit
+        # the same call train.main() makes, including the elastic
+        # shrink-ready 78/76 split (docs/RESILIENCE.md).
+        from distributed_ddpg_tpu.train import (
+            EXIT_POD_DEGRADED,
+            EXIT_POD_SHRINK,
+            pod_degraded_exit,
+        )
 
-        pod_degraded_exit()
+        pod_degraded_exit(
+            code=(
+                EXIT_POD_SHRINK
+                if out.get("pod_shrink_ready")
+                else EXIT_POD_DEGRADED
+            )
+        )
     if out.get("preempted"):
         raise SystemExit(EXIT_PREEMPTED)
 

@@ -241,3 +241,145 @@ def test_sigterm_takes_emergency_checkpoint_and_exits_75(tmp_path):
     assert ok, why
     final = [r for r in _records(log_path) if r["kind"] == "final"]
     assert final and final[-1]["emergency_ckpt"] == 1
+
+
+# --------------------------------------------------------------------------
+# elastic-pod slice faults (faults.py `slice` component; docs/RESILIENCE.md
+# shrink/grow state machine, docs/REPLAY_SHARDING.md all-writer slices)
+# --------------------------------------------------------------------------
+
+
+def test_slice_fault_specs_parse_and_scope_to_process():
+    from distributed_ddpg_tpu.faults import FaultPlan
+
+    plan = FaultPlan.parse("slice:0:corrupt@1;slice:1:kill@2", seed=0)
+    assert bool(plan.slice_site(0)) and bool(plan.slice_site(1))
+    assert not plan.slice_site(2)
+    assert {s.describe() for s in plan.specs} == {
+        "slice:0:corrupt@1", "slice:1:kill@2",
+    }
+    # Only corrupt/kill apply to slice writes; targets are process ids.
+    with pytest.raises(ValueError, match="slice"):
+        FaultPlan.parse("slice:0:hang@1")
+    with pytest.raises(ValueError, match="slice"):
+        FaultPlan.parse("slice:x:corrupt@1")
+
+
+def _synthetic_slice_sets(seed, size=96, width=7, nslices=2, capacity=128):
+    """A logical replay state plus its position-strided slice partition
+    (replay/device.py split_slice_state) — checkpoint-layer drills don't
+    need a live sharded buffer."""
+    import numpy as np
+
+    from distributed_ddpg_tpu.replay.device import split_slice_state
+
+    rng = np.random.default_rng(seed)
+    state = {
+        "packed": rng.standard_normal((size, width)).astype(np.float32),
+        "ptr": np.asarray(0),
+        "size": np.asarray(size),
+    }
+    return state, split_slice_state(state, nslices, capacity)
+
+
+def test_slice_corruption_quarantines_one_slice_and_falls_back(
+    tmp_path, capfd
+):
+    """Torn-shard-write drill (slice:1:corrupt@2): writer 1's second
+    slice write lands torn AFTER its digest sidecar, so verification
+    catches the tear, quarantines ONLY that slice (the step's sibling
+    slice and learner state stay valid), and adoption falls back to the
+    newest OLDER complete set — the adopt-verified-slice branch. With no
+    older complete set the lookup returns None: the exit-76 fallback
+    branch (train.py)."""
+    import numpy as np
+
+    from distributed_ddpg_tpu.faults import FaultPlan
+    from distributed_ddpg_tpu.replay.device import merge_slice_states
+
+    d = str(tmp_path / "ckpt")
+    plan = FaultPlan.parse("slice:1:corrupt@2", seed=0)
+    sites = [plan.slice_site(0), plan.slice_site(1)]
+
+    # Step 10: both writers land clean (writer 1's site ticks ordinal 1).
+    state10, slices10 = _synthetic_slice_sets(seed=3)
+    for k, sl in enumerate(slices10):
+        ckpt_lib.write_replay_slice(d, 10, k, 2, sl, fault=sites[k])
+    complete, n, _ = ckpt_lib.slice_status(d, 10)
+    assert complete and n == 2
+
+    # Step 20: writer 1's second write fires the injected tear.
+    state20, slices20 = _synthetic_slice_sets(seed=4)
+    for k, sl in enumerate(slices20):
+        ckpt_lib.write_replay_slice(d, 20, k, 2, sl, fault=sites[k])
+    assert sites[1].fired == ["slice:1:corrupt@2"]
+    complete, n, status = ckpt_lib.slice_status(d, 20)
+    assert not complete and n == 2
+    ok0, _ = status[0]
+    ok1, why1 = status[1]
+    assert ok0 and not ok1, status
+    assert "mismatch" in why1, why1
+
+    # Quarantine moves ONLY the torn slice out of the namespace.
+    capfd.readouterr()
+    complete, _ = ckpt_lib.verify_replay_slices(d, 20, quarantine=True)
+    assert not complete
+    assert "quarantined corrupt replay slice" in capfd.readouterr().err
+    root = os.path.join(d, ckpt_lib.SLICE_DIRNAME, "step_20")
+    assert os.path.exists(os.path.join(root, "slice_1_of_2.npz.corrupt"))
+    assert os.path.exists(os.path.join(root, "slice_0_of_2.npz"))
+
+    # Adopt-verified-slice branch: fallback lands on step 10, and the
+    # merged set reproduces the original logical state bit-for-bit.
+    assert ckpt_lib.latest_complete_slice_step(d) == 10
+    merged = merge_slice_states(ckpt_lib.load_replay_slices(d, 10))
+    np.testing.assert_array_equal(merged["packed"], state10["packed"])
+    assert int(merged["size"]) == int(state10["size"])
+
+    # Exit-76 fallback branch: nothing complete below step 10.
+    assert ckpt_lib.latest_complete_slice_step(d, at_or_below=9) is None
+    # load_replay_slices refuses the incomplete step loudly.
+    with pytest.raises(RuntimeError, match="incomplete"):
+        ckpt_lib.load_replay_slices(d, 20)
+
+
+def test_slice_kill_dies_before_any_byte_lands(tmp_path):
+    """Peer-loss-during-checkpoint drill (slice:0:kill@1): the writer
+    SIGKILLs itself before any byte of its slice lands — the dead peer's
+    files simply never exist, the step's set reads incomplete, and
+    adoption must fall back to an older complete set (or exit 76 when
+    none exists). Runs in a subprocess: the kill is a real SIGKILL."""
+    d = str(tmp_path / "ckpt")
+    code = (
+        "import numpy as np\n"
+        "from distributed_ddpg_tpu import checkpoint as ckpt_lib\n"
+        "from distributed_ddpg_tpu.faults import FaultPlan\n"
+        "site = FaultPlan.parse('slice:0:kill@1', seed=0).slice_site(0)\n"
+        "sl = {'positions': np.arange(4, dtype=np.int64),\n"
+        "      'rows': np.zeros((4, 3), np.float32),\n"
+        "      'ptr': np.asarray(0), 'size': np.asarray(4),\n"
+        "      'capacity': np.asarray(8)}\n"
+        f"ckpt_lib.write_replay_slice({d!r}, 5, 0, 2, sl, fault=site)\n"
+        "print('UNREACHABLE')\n"
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout, proc.stderr,
+    )
+    assert "UNREACHABLE" not in proc.stdout
+    # No byte landed: neither payload nor sidecar, so the set is simply
+    # incomplete and nothing needs quarantining.
+    root = os.path.join(d, ckpt_lib.SLICE_DIRNAME, "step_5")
+    assert not os.path.exists(os.path.join(root, "slice_0_of_2.npz"))
+    assert not os.path.exists(os.path.join(root, "slice_0_of_2.json"))
+    complete, _, _ = ckpt_lib.slice_status(d, 5)
+    assert not complete
+    assert ckpt_lib.latest_complete_slice_step(d) is None

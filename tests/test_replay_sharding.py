@@ -593,3 +593,168 @@ def test_sharded_per_draw_clamps_to_live_rows():
         # dynamic range (an empty slot would produce a ~1e5x outlier max
         # that normalizes everything else to ~0).
         assert w.min() > 1e-4, (seed, w.min())
+
+
+# --------------------------------------------------------------------------
+# all-writer slices + N->M reshard matrix (ISSUE 17; docs/REPLAY_SHARDING.md
+# 'All-writer replay slices', docs/RESILIENCE.md shrink/grow state machine)
+# --------------------------------------------------------------------------
+
+
+def test_slice_state_dict_single_process_covers_ring():
+    """Single-process slice_state_dict is the whole logical ring as one
+    1-of-1 slice: positions [0, size), rows in wire order, and (PER) the
+    live priority vector — so a 1-process 'pod' writes the same format N
+    writers do and merge_slice_states([slice]) is the identity."""
+    from distributed_ddpg_tpu.replay.device import merge_slice_states
+
+    mesh = make_mesh(-1, 1)
+    for cls in (DeviceReplay, DevicePrioritizedReplay):
+        rep = cls(256, OBS, ACT, mesh=mesh, block_size=64,
+                  replay_sharding="sharded")
+        rep.add_packed(_rows(np.random.default_rng(5), 128))
+        sl = rep.slice_state_dict()
+        np.testing.assert_array_equal(
+            np.asarray(sl["positions"]), np.arange(128, dtype=np.int64)
+        )
+        assert int(sl["capacity"]) == 256
+        st = rep.state_dict()
+        merged = merge_slice_states([sl])
+        np.testing.assert_array_equal(merged["packed"], st["packed"])
+        assert int(merged["ptr"]) == int(st["ptr"])
+        assert int(merged["size"]) == int(st["size"])
+        if "priorities" in st:
+            np.testing.assert_array_equal(
+                np.asarray(merged["priorities"], np.float32),
+                np.asarray(st["priorities"], np.float32),
+            )
+
+
+def test_reshard_matrix_roundtrip_equals_single_host_oracle(tmp_path):
+    """The N->M reshard acceptance matrix over {1,2,4}^2, uniform + PER:
+    an n-writer slice set (the split of a single-host oracle state)
+    written through checkpoint.write_replay_slice, digest-verified,
+    loaded back, merged, and loaded into a sharded buffer must reproduce
+    the oracle's logical ring bit-for-bit — including the PER priority
+    vector rebuild — and re-splitting to m writers round-trips the same
+    state (the grow/shrink algebra is position-driven, so the writer
+    count is free to change at every restart)."""
+    from distributed_ddpg_tpu import checkpoint as ckpt_lib
+    from distributed_ddpg_tpu.replay.device import (
+        merge_slice_states,
+        split_slice_state,
+    )
+
+    mesh = make_mesh(-1, 1)
+    for cls in (DeviceReplay, DevicePrioritizedReplay):
+        rng = np.random.default_rng(11)
+        oracle_rep = cls(256, OBS, ACT, mesh=mesh, block_size=64,
+                         replay_sharding="replicated")
+        oracle_rep.add_packed(_rows(rng, 192))
+        oracle = oracle_rep.state_dict()
+        if "priorities" in oracle:
+            # Non-uniform priorities so the vector rebuild is observable
+            # (a uniform stamp would mask a dropped/reordered slice).
+            oracle["priorities"] = np.linspace(
+                0.2, 4.0, int(oracle["size"])
+            ).astype(np.float32)
+            oracle["max_priority"] = np.asarray(5.0, np.float32)
+        target = cls(256, OBS, ACT, mesh=mesh, block_size=64,
+                     replay_sharding="sharded")
+        for n in (1, 2, 4):
+            d = str(tmp_path / f"{cls.__name__}_n{n}")
+            for k, sl in enumerate(split_slice_state(oracle, n, 256)):
+                ckpt_lib.write_replay_slice(d, 7, k, n, sl)
+            complete, nprocs = ckpt_lib.verify_replay_slices(d, 7)
+            assert complete and nprocs == n, (complete, nprocs)
+            merged = merge_slice_states(ckpt_lib.load_replay_slices(d, 7))
+            np.testing.assert_array_equal(merged["packed"], oracle["packed"])
+            # The production load path: the merged wire state lands in a
+            # sharded buffer (the M-process counterpart scatters the same
+            # replicated logical rows through the reshard program).
+            target.load_state_dict(merged)
+            back = target.state_dict()
+            np.testing.assert_array_equal(back["packed"], oracle["packed"])
+            assert int(back["ptr"]) == int(oracle["ptr"])
+            assert int(back["size"]) == int(oracle["size"])
+            if "priorities" in oracle:
+                np.testing.assert_array_equal(
+                    np.asarray(back["priorities"], np.float32),
+                    oracle["priorities"],
+                )
+                assert float(back["max_priority"]) == 5.0
+            for m in (1, 2, 4):
+                # Re-split to m writers (the next incarnation's slice
+                # set) and merge back: bit-identical to the oracle.
+                reslices = split_slice_state(back, m, 256)
+                assert len(reslices) == m
+                assert sum(
+                    len(s["positions"]) for s in reslices
+                ) == int(oracle["size"])
+                remerged = merge_slice_states(reslices)
+                np.testing.assert_array_equal(
+                    remerged["packed"], oracle["packed"]
+                )
+                if "priorities" in oracle:
+                    np.testing.assert_array_equal(
+                        np.asarray(remerged["priorities"], np.float32),
+                        oracle["priorities"],
+                    )
+
+
+def test_merge_slice_states_rejects_holes_overlaps_and_forks():
+    """A slice set that mixes worlds must fail LOUDLY: silently loading a
+    holed or overlapping set would corrupt the data distribution the
+    learner resumes on (docs/REPLAY_SHARDING.md)."""
+    from distributed_ddpg_tpu.replay.device import (
+        ReplayUsageError,
+        merge_slice_states,
+        split_slice_state,
+    )
+
+    rng = np.random.default_rng(13)
+    state = {
+        "packed": rng.standard_normal((64, W)).astype(np.float32),
+        "ptr": np.asarray(0), "size": np.asarray(64),
+    }
+    a, b = split_slice_state(state, 2, 256)
+    with pytest.raises(ReplayUsageError, match="does not cover"):
+        merge_slice_states([a])                       # hole
+    with pytest.raises(ReplayUsageError, match="overlap"):
+        merge_slice_states([a, a])                    # overlap
+    forked = dict(b, ptr=np.asarray(32))
+    with pytest.raises(ReplayUsageError, match="ring scalars"):
+        merge_slice_states([a, forked])               # mixed steps
+    with pytest.raises(ReplayUsageError, match="empty"):
+        merge_slice_states([])
+
+
+def test_single_shard_sharded_load_state_dict_roundtrip():
+    """A 1-device 'sharded' ring (data axis 1 — what a plain CLI run on
+    one CPU device builds) must still load checkpoints: device_get hands
+    back a read-only buffer and the logical permutation is an identity
+    there, so the load path must copy before writing (regression: the
+    elastic CLI resume crashed with 'assignment destination is
+    read-only')."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model")
+    )
+    rng = np.random.default_rng(17)
+    for cls in (DeviceReplay, DevicePrioritizedReplay):
+        src = cls(128, OBS, ACT, mesh=mesh, block_size=32,
+                  replay_sharding="sharded")
+        src.add_packed(_rows(rng, 96))
+        state = src.state_dict()
+        dst = cls(128, OBS, ACT, mesh=mesh, block_size=32,
+                  replay_sharding="sharded")
+        dst.load_state_dict(state)
+        back = dst.state_dict()
+        np.testing.assert_array_equal(back["packed"], state["packed"])
+        assert int(back["size"]) == 96
+        if "priorities" in state:
+            np.testing.assert_array_equal(
+                np.asarray(back["priorities"], np.float32),
+                np.asarray(state["priorities"], np.float32),
+            )
