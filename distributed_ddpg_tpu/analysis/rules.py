@@ -799,7 +799,9 @@ def _doc_field_patterns(doc_text: str) -> List[re.Pattern]:
         for cand in _expand_slash(token):
             if "<" in cand:
                 rx = re.escape(cand)
-                rx = re.sub(r"\\<[a-z_]+\\>", r"[a-z0-9_]+", rx)
+                # re.escape stopped escaping <> in Python 3.7: accept the
+                # template marker with or without the backslashes.
+                rx = re.sub(r"\\?<[a-z_]+\\?>", r"[a-z0-9_]+", rx)
                 patterns.append(re.compile(rx + r"$"))
     return patterns
 

@@ -398,6 +398,15 @@ class DDPGConfig:
     # Ring capacity in events; at steady state ~4 events per learner chunk
     # + shipper/eval activity, 65536 holds tens of minutes of timeline.
     trace_events: int = 65_536
+    # Telemetry-plane ingress (obs/; docs/OBSERVABILITY.md §4): when > 0,
+    # train_jax starts one stdlib HTTP exporter thread on this port
+    # serving /metrics (Prometheus text from the latest JSONL record),
+    # /healthz (the typed healthy/degraded/draining state machine the
+    # supervisor and canary gate consume), and /trace (on-demand
+    # flight-recorder export). Read-only, no auth, binds all interfaces —
+    # private networks only. 0 = off (default). Multi-process pods give
+    # each process its OWN port (e.g. base + process index).
+    obs_port: int = 0
 
     # --- fault injection & supervised recovery (docs/RESILIENCE.md) ---
     # Deterministic fault schedule (faults.FaultPlan grammar), e.g.
@@ -1049,6 +1058,11 @@ class DDPGConfig:
             raise ValueError("pod_startup_grace_s must be >= 0")
         if self.trace_events < 16:
             raise ValueError("trace_events must be >= 16")
+        if not 0 <= self.obs_port < 65536:
+            raise ValueError(
+                f"obs_port must be 0 (off) or a valid TCP port, "
+                f"got {self.obs_port}"
+            )
         if self.transport not in ("auto", "shm", "queue"):
             raise ValueError(
                 f"transport must be 'auto', 'shm', or 'queue', got "

@@ -63,6 +63,11 @@ Fault semantics by component:
     pod:<proc>:hang@K~S      process <proc> freezes S seconds (default:
                              effectively forever) at its K-th beat — the
                              hung-peer flavor of the same contract
+    pod:<proc>:slow@K~S      process <proc> sleeps S seconds at its K-th
+                             beat and CONTINUES — a surviving straggler,
+                             not a lost peer: the pod aggregator's
+                             per-host beat-time spread must attribute it
+                             (obs/aggregate.py, docs/OBSERVABILITY.md §4)
     numeric:grad:nan@K       the K-th guarded learner step computes against
                              a NaN-poisoned minibatch (NaN grads/TD) — the
                              guardrails probe (guardrails.py) must skip the
@@ -142,7 +147,7 @@ SLOW_FAULT_STEPS = 200
 # of a multi-host pod at a lockstep-beat ordinal (docs/RESILIENCE.md).
 _WORKER_KINDS = ("crash", "crashloop", "hang", "stall", "slow")
 _SITE_KINDS = ("crash", "hang", "slow", "ioerror")
-_POD_KINDS = ("kill", "hang")
+_POD_KINDS = ("kill", "hang", "slow")
 # Slice faults target one process's all-writer replay-slice writes
 # (checkpoint.write_replay_slice): `corrupt` tears the payload after the
 # digest landed, `kill` dies before any byte does.

@@ -12,6 +12,7 @@ losses, mean Q, grad norms, buffer fill, actor/learner steps/sec, staleness.
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import threading
@@ -32,6 +33,9 @@ class MetricsLogger:
         # log() is called from the train loop AND from the background eval
         # thread (train.py); serialize sinks so JSONL lines never interleave.
         self._lock = threading.Lock()
+        # Latest record per kind: the live /metrics endpoint's source
+        # (obs/exporter.py) — a scrape must never replay the file.
+        self._latest: Dict[str, Dict[str, Any]] = {}
         self._tb = None
         if tb_dir:
             try:
@@ -43,6 +47,14 @@ class MetricsLogger:
             except Exception as e:  # degrade to JSONL-only, loudly once
                 warnings.warn(f"tb_dir={tb_dir!r} requested but TensorBoard "
                               f"writer unavailable: {e}")
+        # Every stream opens with ONE header record carrying the absolute
+        # wall-clock base: `wall_time` below is seconds since logger
+        # creation, so without this a pod's N per-process JSONL files (or
+        # two runs of one config) cannot be joined on time at all —
+        # merge tooling computes absolute event time as
+        # t_unix_base + wall_time (docs/OBSERVABILITY.md §1).
+        self.t_unix_base = round(self._t0, 6)
+        self.log("header", 0, t_unix_base=self.t_unix_base, pid=os.getpid())
 
     def log(self, kind: str, step: int, **fields: Any) -> Dict[str, Any]:
         rec = {
@@ -53,6 +65,7 @@ class MetricsLogger:
         }
         line = json.dumps(rec)
         with self._lock:
+            self._latest[kind] = rec
             if self._file:
                 self._file.write(line + "\n")
             if self._echo:
@@ -63,6 +76,13 @@ class MetricsLogger:
                         continue
                     self._tb.add_scalar(f"{kind}/{k}", v, step)
         return rec
+
+    def latest(self) -> Dict[str, Dict[str, Any]]:
+        """{kind: most recent record} — the /metrics render source
+        (obs/exporter.py). Shallow-copied so the scrape thread iterates
+        a stable dict while the train loop keeps logging."""
+        with self._lock:
+            return dict(self._latest)
 
     def close(self) -> None:
         if self._file:
@@ -700,6 +720,13 @@ class PodStats:
                                   healthy)
       pod_state_degraded          1 while the pod trains below the slice
                                   set's writer count, 0 once grown back
+
+    Straggler attribution (obs/aggregate.py; docs/OBSERVABILITY.md §4):
+
+      pod_stragglers              cadences on which the per-host beat-time
+                                  detector attributed a straggling host
+      pod_straggler_host          the most recently attributed host index
+                                  (-1 = never attributed)
     """
 
     NEAR_MISS_FRAC = 0.8
@@ -716,6 +743,8 @@ class PodStats:
         self.shrinks = 0
         self.grows = 0
         self.degraded = False
+        self.stragglers = 0
+        self.straggler_host = -1
         self._deadline_s = 0.0
         self._elapsed = _Reservoir(
             64, (zlib.crc32(b"pod_collective") ^ seed) & 0x7FFFFFFF
@@ -759,6 +788,13 @@ class PodStats:
             self.grows += 1
             self.degraded = False
 
+    def record_straggler(self, host: int) -> None:
+        """One straggler attribution from the pod aggregator's per-host
+        beat-time detector (obs/aggregate.py)."""
+        with self._lock:
+            self.stragglers += 1
+            self.straggler_host = int(host)
+
     def elastic_events(self) -> int:
         """Nonzero when any elastic transition happened — the gate for
         surfacing pod_* fields on runs that shrank to one process
@@ -791,6 +827,8 @@ class PodStats:
                 "pod_shrinks": self.shrinks,
                 "pod_grows": self.grows,
                 "pod_state_degraded": int(self.degraded),
+                "pod_stragglers": self.stragglers,
+                "pod_straggler_host": self.straggler_host,
             }
 
 

@@ -1,0 +1,18 @@
+"""Telemetry plane (docs/OBSERVABILITY.md §4): live per-process ingress
+(`/metrics` + `/healthz` + `/trace`, obs/exporter.py), the typed health
+state machine (obs/health.py), and cross-host metric aggregation with
+straggler attribution (obs/aggregate.py). Everything here is stdlib +
+numpy — no jax import, so the exporter and aggregator unit-test without
+a device runtime (the multihost gather is injected by train.py)."""
+
+from distributed_ddpg_tpu.obs import health
+from distributed_ddpg_tpu.obs.aggregate import PodAggregator, detect_straggler
+from distributed_ddpg_tpu.obs.exporter import ObsExporter, render_prometheus
+
+__all__ = [
+    "health",
+    "PodAggregator",
+    "detect_straggler",
+    "ObsExporter",
+    "render_prometheus",
+]

@@ -153,6 +153,7 @@ def wait_beat_ticket(ticket, label: str = "sync_ship beat"):
     try:
         return ticket.result(timeout=timeout)
     except TimeoutError as e:
+        _note_peer_lost(f"pod_peer_lost:{label}")
         raise PodPeerLost(
             f"background {label} unresolved after {timeout:.0f}s — the "
             "ordered beat lane is wedged (scheduler stalled or a peer "
@@ -211,6 +212,7 @@ def call_with_deadline(fn, timeout_s: Optional[float] = None,
         from distributed_ddpg_tpu import trace
 
         trace.instant("pod_peer_lost", label=label, deadline_s=t)
+        _note_peer_lost(f"pod_peer_lost:{label}")
         raise PodPeerLost(
             f"pod collective {label!r} missed its {t:.1f}s deadline — a "
             f"peer process is gone or hung ({_liveness_note()})",
@@ -225,6 +227,21 @@ def call_with_deadline(fn, timeout_s: Optional[float] = None,
     if stats is not None:
         stats.record_collective(elapsed, t)
     return box["result"]
+
+
+def _note_peer_lost(reason: str) -> None:
+    """Flip the process health state (obs/health.py) to degraded the
+    moment a peer is declared lost — the /healthz endpoint must read
+    degraded DURING the coordinated abort's teardown window (emergency
+    checkpoint, election, linger), not only in the exit code after it.
+    Lazy import + broad except: the typed-abort path must never gain a
+    new failure mode from a diagnostics layer."""
+    try:
+        from distributed_ddpg_tpu.obs import health
+
+        health.get().note(reason)
+    except Exception:
+        pass
 
 
 def _parse_peer(message: str) -> Optional[int]:
@@ -397,6 +414,7 @@ def allgather_scalar(value, dtype=None, timeout_s: Optional[float] = None,
             from distributed_ddpg_tpu import trace
 
             trace.instant("pod_peer_lost", label=label, error=repr(e)[:120])
+            _note_peer_lost(f"pod_peer_lost:{label}")
             raise PodPeerLost(
                 f"pod collective {label!r} failed mid-flight: {e!r} "
                 f"({_liveness_note()})",
@@ -455,6 +473,35 @@ def startup_barrier(grace_s: float, label: str = "pod_startup_barrier") -> None:
         f"synchronized in {time.monotonic() - t0:.1f}s",
         file=sys.stderr, flush=True,
     )
+
+
+def clock_handshake(label: str = "clock_handshake") -> Optional[dict]:
+    """Startup monotonic<->wall offset handshake (docs/OBSERVABILITY.md
+    §4): each process all-gathers its wall clock (int64 ms — the uniform
+    transport, one more reuse of the single compiled gather executable)
+    at ONE synchronized point, so every host learns every other host's
+    wall-clock offset relative to rank 0. The per-host flight-recorder
+    ring anchors timestamps to its own (wall_t0, perf_counter) pair;
+    these offsets are the correction term `tools.runs merge-trace` uses
+    to put N per-host timelines on one aligned clock — without them a
+    skewed NTP host's spans land visibly out of order against the
+    collectives they participated in. The gather itself bounds the skew
+    measurement error at the collective's in-flight time. Returns
+    {"wall_ms": [per-host], "offset_ms": [per-host, rank0-relative]};
+    None single-process."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return None
+    gathered = allgather_scalar(
+        np.int64(int(time.time() * 1000.0)), label=label
+    )
+    wall_ms = [int(v) for v in np.asarray(gathered).reshape(-1)]
+    return {
+        "wall_ms": wall_ms,
+        "offset_ms": [v - wall_ms[0] for v in wall_ms],
+    }
 
 
 def _common_step(gathered) -> int:

@@ -119,6 +119,16 @@ class InferenceServer:
         self.batcher.start()
         return self
 
+    def overloaded(self, frac: float = 0.9) -> bool:
+        """Live degraded-condition probe for the telemetry plane
+        (obs/health.py `register_probe`): True while the bounded request
+        queue sits past `frac` of capacity — the point where new
+        requests are about to shed (Batcher's typed backpressure) and a
+        canary gate must stop shifting traffic toward this process.
+        Evaluated on the /healthz scrape thread, so it reads the queue
+        as it is NOW, not at the last log cadence (docs/SERVING.md)."""
+        return self.batcher.depth() >= frac * self.batcher.max_queue
+
     def close(self, timeout: float = 30.0) -> None:
         """Flush-on-shutdown: the batcher drains every accepted request
         before its thread exits (serve/batcher.py contract)."""

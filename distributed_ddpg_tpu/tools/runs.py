@@ -23,6 +23,11 @@ documented in docs/OBSERVABILITY.md):
                                  artifact; docs/ANALYSIS.md) as the same
                                  digest tables; exit 2 on unsuppressed
                                  findings — the bench gate's contract.
+  merge-trace <t0.json> ...      fuse N per-host flight-recorder traces
+                                 into ONE Perfetto timeline (process
+                                 track per host, clocks aligned by the
+                                 startup handshake offsets —
+                                 docs/OBSERVABILITY.md §4).
 
 Pure stdlib, no numpy/jax: this must be runnable anywhere, instantly —
     python -m distributed_ddpg_tpu.tools.runs summarize runs/foo.jsonl
@@ -188,6 +193,10 @@ POD_KEYS = (
     "pod_shrinks",
     "pod_grows",
     "pod_state_degraded",
+    # Straggler attribution (obs/aggregate.py; docs/OBSERVABILITY.md §4):
+    # cumulative detections plus the last flagged host index (-1 = none).
+    "pod_stragglers",
+    "pod_straggler_host",
 )
 
 # Numerical-health counters (metrics.GuardrailStats; docs/RESILIENCE.md
@@ -207,10 +216,33 @@ GUARDRAIL_KEYS = (
 )
 
 
+def _drop_probe_failures(
+    records: List[Dict[str, Any]], path: str
+) -> List[Dict[str, Any]]:
+    """Drop records carrying a TPU-probe failure tail (`probe_error` /
+    `tpu_error` — the BENCH_r04/r05 shape: the harness recorded a CPU
+    fallback after the TPU probe died). Their rates are fallback numbers,
+    not the run's, and silently averaging them in would poison every A/B
+    against a healthy baseline (BENCH_r03). Warns once per file so the
+    exclusion is visible, never manual."""
+    kept = [
+        r for r in records
+        if not (r.get("probe_error") or r.get("tpu_error"))
+    ]
+    dropped = len(records) - len(kept)
+    if dropped:
+        print(
+            f"warning: {path}: skipped {dropped} record(s) with a "
+            "TPU-probe failure tail (probe_error/tpu_error)",
+            file=sys.stderr,
+        )
+    return kept
+
+
 def summarize_run(path: str) -> Dict[str, Any]:
     """Machine-readable digest of one JSONL run (the CLI renders it; tests
     and future dashboards consume it directly)."""
-    records = load_jsonl(path)
+    records = _drop_probe_failures(load_jsonl(path), path)
     kinds = by_kind(records)
     train = kinds.get("train", [])
     evals = kinds.get("eval", [])
@@ -278,10 +310,17 @@ def summarize_run(path: str) -> Dict[str, Any]:
     digest["transfer"] = transfer
 
     # Pod digest (multi-process runs only): last value of each pod_*
-    # counter/gauge across train+final records.
+    # counter/gauge across train+final records, plus whatever aggregation
+    # keys the rank-0 `kind:"pod"` records carry (obs/aggregate.py emits
+    # per-host min/max/spread families; the key set is family-templated,
+    # so it is discovered, not enumerated).
     pod = {}
-    for key in POD_KEYS:
-        vals = _col(train + kinds.get("final", []), key)
+    pod_records = kinds.get("pod", [])
+    pod_key_set = set(POD_KEYS) | {
+        k for r in pod_records for k in r if k.startswith("pod_")
+    }
+    for key in sorted(pod_key_set):
+        vals = _col(train + pod_records + kinds.get("final", []), key)
         if vals:
             pod[key] = {"last": vals[-1], "max": max(vals)}
     digest["pod"] = pod
@@ -645,8 +684,9 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
             lower_better=("bytes_per_row" in key or "_ms" in key
                           or "p95" in key or "p50" in key))
     for key in sorted(set(a.get("pod", {})) | set(b.get("pod", {}))):
-        if key in ("pod_resume_step_elected", "pod_slice_adopted_step"):
-            continue  # elected/adopted steps are context, not metrics to delta
+        if key in ("pod_resume_step_elected", "pod_slice_adopted_step",
+                   "pod_straggler_host", "pod_agg_hosts"):
+            continue  # steps/host indices/world size: context, not deltas
         pa = a.get("pod", {}).get(key, {})
         pb = b.get("pod", {}).get(key, {})
         add(key, pa.get("last"), pb.get("last"),
@@ -751,6 +791,79 @@ def gate_bench(
         )
         ok = ok and not bad
     return ok, lines
+
+
+# ---------------------------------------------------------------------------
+# merge-trace
+# ---------------------------------------------------------------------------
+
+
+def merge_traces(paths: Sequence[str], out_path: str) -> Tuple[int, int]:
+    """Fuse N per-host Chrome-trace files (trace.py export) into ONE
+    Perfetto timeline with a process track per host, on an aligned clock.
+
+    Each input's events carry ts relative to that process's own recorder
+    start; its `otherData.wall_t0` anchors them to the host's wall clock,
+    and `otherData.clock_offset_ms` (the startup clock handshake,
+    parallel/multihost.clock_handshake) removes the host's measured skew
+    from host 0 — so the merged timeline aligns on HANDSHAKE time, not on
+    whatever NTP left each host believing. Events are re-based to the
+    earliest aligned anchor, each input's pids are remapped to its host
+    index (Perfetto renders one process track per pid), and a
+    `process_name` metadata event labels each track with the host index,
+    original pid, and source file. Returns (events_written, n_inputs)."""
+    loaded = []
+    for i, path in enumerate(paths):
+        with open(path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+        events = obj.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError(f"{path}: not a Chrome trace (no traceEvents)")
+        od = obj.get("otherData") or {}
+        wall_t0 = od.get("wall_t0")
+        offset_ms = od.get("clock_offset_ms") or 0.0
+        # Aligned anchor: this recorder's ts=0 expressed on host 0's
+        # clock. A file without wall_t0 (foreign trace) anchors at 0.
+        base = (
+            float(wall_t0) - float(offset_ms) / 1e3
+            if isinstance(wall_t0, (int, float))
+            else None
+        )
+        host = od.get("process_index")
+        loaded.append((path, events, od, base,
+                       host if isinstance(host, int) else i))
+    known = [base for (_, _, _, base, _) in loaded if base is not None]
+    t0 = min(known) if known else 0.0
+
+    merged: List[Dict[str, Any]] = []
+    for path, events, od, base, host in loaded:
+        shift_us = ((base - t0) * 1e6) if base is not None else 0.0
+        for ev in events:
+            ev = dict(ev)
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = ev["ts"] + shift_us
+            ev["pid"] = host
+            merged.append(ev)
+        label = f"host{host} pid={od.get('pid', '?')}"
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": host, "ts": 0,
+            "args": {"name": f"{label} ({path})"},
+        })
+        merged.append({
+            "name": "process_sort_index", "ph": "M", "pid": host, "ts": 0,
+            "args": {"sort_index": host},
+        })
+    out = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": list(paths),
+            "t_unix_base": t0,
+        },
+    }
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(out, fh)
+    return len(merged), len(loaded)
 
 
 # ---------------------------------------------------------------------------
@@ -876,6 +989,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="report JSON (default: runs/program_findings.json, the "
         "proganalyze_gate.sh default artifact)",
     )
+    p_mt = sub.add_parser(
+        "merge-trace", help="fuse N per-host Chrome traces (trace.py "
+        "export) into one Perfetto timeline with a process track per "
+        "host, clock-aligned via the startup handshake offsets",
+    )
+    p_mt.add_argument("paths", nargs="+",
+                      help="per-host trace JSON files, one per process")
+    p_mt.add_argument("--out", default="trace_merged.json",
+                      help="merged timeline path (default: "
+                      "trace_merged.json)")
 
     args = parser.parse_args(argv)
 
@@ -916,6 +1039,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(line)
         print("GATE PASS" if ok else "GATE FAIL")
         return 0 if ok else 2
+
+    if args.cmd == "merge-trace":
+        try:
+            n_events, n_hosts = merge_traces(args.paths, args.out)
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"merged {n_events} events from {n_hosts} host trace(s) -> "
+            f"{args.out} (load in ui.perfetto.dev)"
+        )
+        return 0
 
     if args.cmd == "lint":
         try:

@@ -110,8 +110,21 @@ class TraceRecorder:
         # would be ~10% of the span budget (the <2% overhead guard).
         self._tl = threading.local()
         # Wall-clock anchor for correlating trace timestamps with JSONL
-        # wall_time / log lines.
+        # wall_time / log lines — and for re-basing N per-host traces
+        # onto one timeline (tools.runs merge-trace): absolute wall time
+        # of any event is wall_t0 + ts/1e6.
         self._wall_t0 = time.time()
+        # Caller-attached export metadata (set_meta): the multi-host
+        # clock handshake lands its per-host offsets here so the merge
+        # tool can correct cross-host wall-clock skew.
+        self._meta: Dict[str, Any] = {}
+        self._meta_lock = threading.Lock()
+
+    def set_meta(self, **kv: Any) -> None:
+        """Attach key/values to the export's otherData block (merged over
+        the defaults). JSON-serializable values only."""
+        with self._meta_lock:
+            self._meta.update(kv)
 
     # --- recording (hot path) ---
 
@@ -194,6 +207,8 @@ class TraceRecorder:
         events = self.events(window_s=window_s)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
+        with self._meta_lock:
+            meta = dict(self._meta)
         with open(path, "w") as f:
             json.dump(
                 {
@@ -203,6 +218,7 @@ class TraceRecorder:
                         "wall_t0": self._wall_t0,
                         "pid": os.getpid(),
                         "argv": " ".join(sys.argv[:6]),
+                        **meta,
                     },
                 },
                 f,
@@ -264,6 +280,41 @@ def export(path: str, window_s: Optional[float] = None) -> int:
     if r is None:
         return 0
     return r.export(path, window_s=window_s)
+
+
+def set_meta(**kv) -> None:
+    """Attach otherData metadata to the singleton's exports (no-op while
+    disabled) — the clock-handshake / process-identity hook."""
+    r = _recorder
+    if r is not None:
+        r.set_meta(**kv)
+
+
+def install_signal_export(path: str) -> bool:
+    """Install a SIGUSR2 handler that exports the singleton's ring to
+    `path` — the live-run timeline poke (train.py arms it alongside the
+    watchdog; the /trace endpoint is the network sibling). Returns True
+    when installed; False on platforms without SIGUSR2 or off the main
+    thread (embedded callers), where signals cannot be installed. The
+    handler never raises: a read-only diagnostic poke must not crash the
+    healthy run it inspects."""
+    import signal as _signal
+
+    if not hasattr(_signal, "SIGUSR2"):
+        return False
+
+    def _export_on_signal(*_):
+        try:
+            export(path)
+        except Exception as e:
+            print(f"[trace] SIGUSR2 export failed: {e!r}",
+                  file=sys.stderr, flush=True)
+
+    try:
+        _signal.signal(_signal.SIGUSR2, _export_on_signal)
+    except ValueError:
+        return False  # not on the main thread
+    return True
 
 
 # ---------------------------------------------------------------------------

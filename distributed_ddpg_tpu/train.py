@@ -397,23 +397,7 @@ def train_jax(config: DDPGConfig) -> Dict[str, float]:
     if config.trace_dir:
         trace.configure(capacity=config.trace_events)
         trace_path = os.path.join(config.trace_dir, "trace.json")
-        import signal
-
-        def _export_on_signal(*_):
-            # A read-only diagnostic poke must never crash the healthy
-            # run it inspects (a raise here propagates into whatever the
-            # learner thread was executing).
-            try:
-                trace.export(trace_path)
-            except Exception as e:
-                print(f"[trace] SIGUSR2 export failed: {e!r}",
-                      file=sys.stderr, flush=True)
-
-        if hasattr(signal, "SIGUSR2"):
-            try:
-                signal.signal(signal.SIGUSR2, _export_on_signal)
-            except ValueError:
-                pass  # not on the main thread (embedded callers): no signal
+        trace.install_signal_export(trace_path)
 
     # Stall watchdog (watchdog.py): covers the WHOLE device lifetime of
     # the impl below — backend/PJRT init (resolve_learner_chunk's
@@ -512,6 +496,16 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     preempt = threading.Event()
     emergency_ckpt = [0]
 
+    # --- telemetry plane (obs/; docs/OBSERVABILITY.md §4) ---
+    # The health state machine is a process singleton (the watchdog and
+    # multihost flip it from their own threads without plumbing); reset
+    # here so back-to-back runs in one process (tests, notebooks) don't
+    # inherit a previous run's latched `draining`.
+    from distributed_ddpg_tpu.obs import ObsExporter, PodAggregator, health
+
+    health.get().reset()
+    obs_server: Optional[ObsExporter] = None
+
     # --- numerical-health guardrails (guardrails.py; docs/RESILIENCE.md) ---
     # The learner's chunk programs carry the on-device probe; this side
     # holds the host half: per-chunk health-word reads, the rolling
@@ -587,6 +581,20 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         )
         try:
             multihost.startup_barrier(config.pod_startup_grace_s)
+            # Clock-alignment handshake (docs/OBSERVABILITY.md §4): one
+            # wall-clock allgather right after the barrier, while every
+            # process is provably at the same program point. Each host
+            # records its offset from host 0 into the flight recorder's
+            # metadata so `tools.runs merge-trace` can fuse the per-host
+            # Chrome traces onto one timeline without trusting NTP.
+            clocks = multihost.clock_handshake()
+            if clocks is not None:
+                trace.set_meta(
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count(),
+                    clock_offset_ms=clocks["offset_ms"][jax.process_index()],
+                    pod_wall_ms=clocks["wall_ms"],
+                )
         except multihost.PodPeerLost as e:
             multihost.configure_pod(0.0)
             return _pod_degraded_early(e)
@@ -671,6 +679,10 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
 
     def _on_sigterm(*_):
         preempt.set()
+        # /healthz must flip to `draining` on the FIRST scrape after the
+        # signal — the supervisor that sent SIGTERM reads it as "ack,
+        # winding down", distinct from degraded-but-recoverable.
+        health.get().drain("preempted (SIGTERM)")
         print(
             "[train] SIGTERM: finishing the in-flight chunk, taking an "
             f"emergency checkpoint, exiting {EXIT_PREEMPTED} (resumable)",
@@ -1154,6 +1166,44 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     pool.start(learner.actor_params_to_host())
     _beat()  # first params d2h survived (an observed wedge point)
     log = MetricsLogger(config.log_path, tb_dir=config.tb_dir)
+
+    # --- live telemetry ingress (obs/exporter.py; docs/OBSERVABILITY.md
+    # §4) --- config.obs_port > 0: a stdlib HTTP thread serves /metrics
+    # (Prometheus text of the latest record per kind + run counters),
+    # /healthz (the typed state machine scrapers gate canaries on), and
+    # /trace (on-demand flight-recorder export). Started after the logger
+    # so the very first scrape already sees the header record; a bind
+    # failure (port taken) downgrades to a warning — telemetry must never
+    # kill the run it observes.
+    if config.obs_port > 0:
+        try:
+            obs_server = ObsExporter(
+                config.obs_port,
+                health=health.get(),
+                latest_fn=log.latest,
+                counters_fn=lambda: {
+                    "t_unix_base": log.t_unix_base,
+                    "process_index": jax.process_index(),
+                    "process_count": jax.process_count(),
+                    "preempt": int(preempt.is_set()),
+                },
+                trace_dir=(config.trace_dir or config.checkpoint_dir or "."),
+            ).start()
+            print(
+                f"[obs] telemetry ingress on :{obs_server.port} "
+                "(/metrics /healthz /trace)",
+                file=sys.stderr, flush=True,
+            )
+        except OSError as e:
+            obs_server = None
+            print(f"[obs] exporter disabled (bind failed: {e})",
+                  file=sys.stderr, flush=True)
+    if serve_server is not None:
+        # Live degraded probe: /healthz reads the serve queue AS OF the
+        # scrape, not the last log cadence (serve/server.py overloaded).
+        health.get().register_probe("serve_overloaded",
+                                    serve_server.overloaded)
+
     learn_timer, env_timer = Timer(), Timer()
     phases = PhaseTimers()
     saver = ckpt_lib.AsyncSaver()
@@ -1767,6 +1817,23 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
     last_monitor_t = 0.0
     support_controller = support_auto.SupportController()
 
+    # --- pod telemetry aggregation (obs/aggregate.py; docs/
+    # OBSERVABILITY.md §4) --- multi-process only: on each log cadence
+    # every process contributes a milli-scaled int64[4] snapshot (beat
+    # time, ingest rate, transfer backlog, wall clock) over the SAME
+    # uniform int64 allgather lane the env-step budget rides, and every
+    # process computes the identical per-host spread + straggler verdict
+    # from the gathered matrix. Rank 0 alone logs the `kind:"pod"`
+    # record — the aggregation view is pod-global, one writer suffices.
+    pod_agg = None
+    if is_multi:
+        pod_agg = PodAggregator(
+            gather_fn=lambda vec: multihost.allgather_scalar(
+                vec, label="pod_obs_gather"
+            ),
+            stats=pod_stats,
+        )
+
     def after_chunk(out, indices, fused: bool = False,
                     beats: int = 1) -> None:
         # `beats`: how many fused beats the dispatch that produced `out`
@@ -1893,6 +1960,46 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 v_max=learner.config.v_max,
                 support_refusals=support_controller.refusals,
             )
+
+        if on_cadence:
+            # Reversible degraded conditions re-sampled every cadence
+            # (obs/health.py note() both raises and clears): a pod that
+            # shrank back to strength or a quarantine that lifted takes
+            # /healthz back to `healthy` at the next cadence.
+            health.get().note("pod_state_degraded", pod_stats.degraded)
+            if guard_on:
+                health.get().note(
+                    "guardrail_quarantine", gstats.source_quarantines > 0
+                )
+        if on_cadence and pod_agg is not None:
+            # Cross-host aggregation gather. Sits OUTSIDE the wall-clock
+            # log gate below: that gate reads per-process wall time, so
+            # processes disagree on it, and a collective issued under it
+            # would fork the pod's collective order. Here the cadence
+            # (replica-identical learn_steps) is the only gate. bg_sync
+            # runs ride the scheduler's lockstep lane like every other
+            # host-initiated collective (docs/TRANSFER.md).
+            def _pod_collect():
+                return pod_agg.collect(
+                    beats=learn_steps // chunk,
+                    ingest_rows=host_env_steps(),
+                    transfer_backlog=(
+                        sum(transfer_sched.queue_depths().values())
+                        if transfer_sched is not None
+                        else 0
+                    ),
+                )
+
+            with phases.phase("pod_obs"):
+                pod_record = (
+                    transfer_sched.run_ordered(
+                        _pod_collect, label="pod_obs_allgather"
+                    )
+                    if bg_sync
+                    else _pod_collect()
+                )
+            if pod_record is not None and jax.process_index() == 0:
+                log.log("pod", env_steps(), **pod_record)
 
         if on_cadence and (config.strict_sync or now - last_log_t >= 1.0):
             last_log_t = now
@@ -2438,6 +2545,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         t = eval_thread["t"]
         if t is not None:
             t.join(timeout=_EVAL_JOIN_S)
+        if obs_server is not None and pod_lost[0] is None:
+            # Clean exits stop the ingress; a pod abort deliberately keeps
+            # it serving — /healthz must answer `degraded` through the
+            # abort window (pod_degraded_exit's rank-0 linger exists
+            # precisely so supervisors can scrape the verdict before the
+            # process disappears).
+            obs_server.stop()
         if is_multi:
             # Disarm the module-level pod deadline: a later single-process
             # train in the same interpreter must keep the zero-overhead

@@ -190,6 +190,21 @@ class Watchdog:
                 with self._grant_lock:
                     granted = now < self._grant_deadline
                 if not granted:
+                    # Telemetry plane first (obs/health.py): /healthz must
+                    # read `draining` while the artifacts below are being
+                    # written — the last scrape a supervisor gets from a
+                    # wedged process should say "terminal", not "healthy".
+                    # Latched, never raises; broad except because the
+                    # stall path must not gain failure modes.
+                    try:
+                        from distributed_ddpg_tpu.obs import health
+
+                        health.get().drain(
+                            "watchdog stall: no trainer progress for "
+                            f"{now - last_change:.0f}s"
+                        )
+                    except Exception:
+                        pass
                     self._write_stall_artifacts(last, now - last_change)
                     self._on_stall()
                     return

@@ -80,8 +80,14 @@
 #              docs/RESILIENCE.md) as a pre-step: slice digest/quarantine
 #              drills and the {1,2,4}^2 N->M replay reshard matrix run on
 #              CPU before any bench JSON is read (ELASTIC_FULL=1 adds the
-#              slow 2-process shrink/grow drill). All flags compose:
-#              `ci_gate.sh --lint --programs --elastic cand.json`.
+#              slow 2-process shrink/grow drill).
+#   --obs      run scripts/obs_smoke.sh (the telemetry-plane smoke,
+#              docs/OBSERVABILITY.md §4) as a pre-step: health state
+#              machine, /metrics + /healthz + /trace ingress, straggler
+#              detection, merge-trace, and the schema-drift pin run on
+#              CPU before any bench JSON is read (OBS_FULL=1 adds the
+#              slow 2-process scrape/peer-loss/merge drill). All flags
+#              compose: `ci_gate.sh --lint --programs --obs cand.json`.
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
@@ -90,10 +96,11 @@ while :; do
         --lint) "$repo_root/scripts/lint_gate.sh"; shift ;;
         --programs) "$repo_root/scripts/proganalyze_gate.sh"; shift ;;
         --elastic) "$repo_root/scripts/elastic_smoke.sh"; shift ;;
+        --obs) "$repo_root/scripts/obs_smoke.sh"; shift ;;
         *) break ;;
     esac
 done
-candidate="${1:?usage: ci_gate.sh [--lint] [--programs] [--elastic] <candidate.json> [baseline.json]}"
+candidate="${1:?usage: ci_gate.sh [--lint] [--programs] [--elastic] [--obs] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
 keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,superstep_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s}"
 

@@ -126,6 +126,14 @@ def run_pod_train(pid: int, tag: str) -> None:
                           on the multiprocess CPU backend (the PR-5
                           child-flake note), and THIS harness is pinning
                           the pod-abort contract, not the overlap.
+      POD_OBS_PORT_BASE   when set, arm the telemetry ingress on port
+                          base+pid (obs/exporter.py): the parent scrapes
+                          /metrics and /healthz live during the drill
+                          (tests/test_obs.py; docs/OBSERVABILITY.md §4)
+      POD_TRACE_DIR       when set, arm the flight recorder with
+                          trace_dir=<dir>/proc<pid> — each child exports
+                          its own trace.json for the parent's merge-trace
+                          assertion (clock-aligned pod timeline)
 
     Prints 'PODRESULT <tag> steps=<n> degraded=<0|1> elected=<step>
     adopted=<n> shrinks=<n> grows=<n> shrinkready=<0|1>' and exits with
@@ -152,6 +160,12 @@ def run_pod_train(pid: int, tag: str) -> None:
     _jax.config.update("jax_cpu_enable_async_dispatch", False)
 
     log_dir = os.environ.get("POD_LOG_DIR", "")
+    obs_port_base = int(os.environ.get("POD_OBS_PORT_BASE", "0"))
+    trace_root = os.environ.get("POD_TRACE_DIR", "")
+    trace_dir = ""
+    if trace_root:
+        trace_dir = os.path.join(trace_root, f"proc{pid}")
+        os.makedirs(trace_dir, exist_ok=True)
     config = DDPGConfig(
         backend="jax_tpu",
         env_id="Pendulum-v1",
@@ -186,6 +200,11 @@ def run_pod_train(pid: int, tag: str) -> None:
         # The pod deadline owns hang detection here; the watchdog's
         # os._exit(70) would race the clean-abort path under test.
         watchdog_s=0.0,
+        # Telemetry plane (obs/; docs/OBSERVABILITY.md §4): per-process
+        # ingress port and per-process trace ring, both off unless the
+        # parent opts in.
+        obs_port=(obs_port_base + pid) if obs_port_base else 0,
+        trace_dir=trace_dir,
     )
     out = train_jax(config)
     print(

@@ -19,10 +19,12 @@ def test_jsonl_records(tmp_path):
     log.log("eval", 20, eval_return=-100.0)
     log.close()
     recs = [json.loads(line) for line in path.read_text().splitlines()]
-    assert [r["kind"] for r in recs] == ["train", "eval"]
-    assert recs[0]["critic_loss"] == 0.5
-    assert recs[0]["note"] == "hi"          # non-numeric passes through
-    assert recs[1]["step"] == 20
+    # The run-start header record (docs/OBSERVABILITY.md §1) always leads.
+    assert [r["kind"] for r in recs] == ["header", "train", "eval"]
+    assert recs[0]["t_unix_base"] > 0 and recs[0]["pid"] == os.getpid()
+    assert recs[1]["critic_loss"] == 0.5
+    assert recs[1]["note"] == "hi"          # non-numeric passes through
+    assert recs[2]["step"] == 20
 
 
 @pytest.mark.slow
@@ -60,7 +62,7 @@ def test_jsonable_preserves_bool_and_int_types(tmp_path):
     log = MetricsLogger(str(path), echo=False)
     log.log("train", 1, active=True, count=3, loss=0.25)
     log.close()
-    rec = json.loads(path.read_text())
+    rec = json.loads(path.read_text().splitlines()[-1])
     assert rec["active"] is True
     assert rec["count"] == 3 and not isinstance(rec["count"], float)
     assert rec["loss"] == 0.25
