@@ -983,3 +983,74 @@ class ObservabilityDrift(Rule):
                     "detection/recovery/artifact row",
                     exact=True,
                 )
+
+
+# ---------------------------------------------------------------------------
+# 8. exit-code-literal
+# ---------------------------------------------------------------------------
+
+# The typed codes (exits.py). Untyped statuses (sys.exit(1), argparse's
+# 2) are not the contract's business and stay unflagged.
+_TYPED_EXIT_CODES = frozenset({70, 75, 76, 77, 78, 79})
+_EXITS_MODULE = "exits.py"
+_EXIT_CALL_LEAVES = ("exit", "_exit", "SystemExit")
+
+
+@register
+class ExitCodeLiteral(Rule):
+    """The typed exit codes (70/75/76/77/78/79) are a cross-process
+    CONTRACT: train, the watchdog, the chaos children, and the pod
+    supervisor all key recovery decisions off them (docs/RESILIENCE.md
+    exit-code matrix). Before exits.py they lived as scattered literals
+    — and one drifted copy turns a shrink-ready exit (relaunch smaller,
+    adopt the slices) into an unknown crash (relaunch blindly). Every
+    typed exit must go through the distributed_ddpg_tpu.exits constants;
+    only exits.py itself may spell the numbers."""
+
+    name = "exit-code-literal"
+    doc = (
+        "no bare typed exit-code literal (70/75/76/77/78/79) in "
+        "sys.exit/os._exit/SystemExit or EXIT_*-named assignments "
+        "outside exits.py — import distributed_ddpg_tpu.exits"
+    )
+
+    def check_module(self, module: Module, ctx: LintContext) -> Iterable[Finding]:
+        if module.tree is None or module.rulepath == _EXITS_MODULE:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf not in _EXIT_CALL_LEAVES or not node.args:
+                    continue
+                val = node.args[0]
+                if (
+                    isinstance(val, ast.Constant)
+                    and isinstance(val.value, int)
+                    and not isinstance(val.value, bool)
+                    and val.value in _TYPED_EXIT_CODES
+                ):
+                    yield module.finding(
+                        self.name, node,
+                        f"bare typed exit code {val.value} in "
+                        f"{name or leaf}() — import the named constant "
+                        "from distributed_ddpg_tpu.exits "
+                        "(docs/RESILIENCE.md exit-code matrix)",
+                    )
+            elif isinstance(node, ast.Assign):
+                val = node.value
+                if not (
+                    isinstance(val, ast.Constant)
+                    and isinstance(val.value, int)
+                    and not isinstance(val.value, bool)
+                    and val.value in _TYPED_EXIT_CODES
+                ):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and "EXIT" in tgt.id.upper():
+                        yield module.finding(
+                            self.name, node,
+                            f"local exit-code constant {tgt.id} = "
+                            f"{val.value} shadows the one-place contract "
+                            "— import it from distributed_ddpg_tpu.exits",
+                        )

@@ -1,0 +1,87 @@
+"""The typed exit-code contract, in ONE place (docs/RESILIENCE.md).
+
+A supervising driver keys recovery decisions off nothing but the child's
+exit status, so these numbers are a cross-process API: train.py raises
+them, watchdog.py hard-exits with one, the chaos/pod test children
+assert on them, and supervisor/core.py dispatches on them. Before this
+module they were scattered literals (train.py, watchdog.py, tests) — one
+drifted copy turns "shrink-ready, relaunch smaller" into "unknown
+crash, relaunch blindly". The `exit-code-literal` lint rule
+(analysis/rules.py) now rejects any new bare typed literal outside this
+file.
+
+The contract, in supervisor-action order:
+
+  EXIT_OK (0)               budget complete, clean teardown. Done.
+  EXIT_WATCHDOG_STALL (70)  EX_SOFTWARE: no trainer progress for
+                            watchdog_s — a blocking device call wedged.
+                            State on disk is whatever the last cadence
+                            checkpoint holds; relaunch-in-place with
+                            backoff.
+  EXIT_PREEMPTED (75)       EX_TEMPFAIL: SIGTERM landed; one emergency
+                            checkpoint written. Fully resumable —
+                            relaunch-in-place.
+  EXIT_POD_DEGRADED (76)    a pod PEER died/hung mid-collective
+                            (PodPeerLost) and NO verified replay slice
+                            set exists. Emergency checkpoint written;
+                            relaunch the WHOLE pod (same dirs — the
+                            resume election restores one common step).
+  EXIT_NUMERIC (77)         guardrails exhausted the rollback budget;
+                            params presumed poisoned, NO checkpoint
+                            written. Do NOT blindly relaunch — inspect
+                            guardrail_* counters first.
+  EXIT_POD_SHRINK (78)      peer lost AND a complete, digest-verified
+                            all-writer slice set is on disk — relaunch
+                            at ANY M (including without the lost host);
+                            slice adoption reshards replay and the run
+                            continues typed-degraded until a grow.
+  EXIT_SUPERVISOR_GAVE_UP (79)
+                            the supervisor itself refused to continue —
+                            crash-loop circuit breaker tripped or a
+                            numeric abort exceeded supervisor_max_numeric.
+                            A structured SupervisorGaveUp report (JSON)
+                            says why; a human decides next.
+
+Negative statuses (as subprocess reports them) are deaths by signal and
+are NOT part of the contract — `describe()` names them for event logs.
+"""
+
+from __future__ import annotations
+
+import signal
+
+EXIT_OK = 0
+EXIT_WATCHDOG_STALL = 70
+EXIT_PREEMPTED = 75
+EXIT_POD_DEGRADED = 76
+EXIT_NUMERIC = 77
+EXIT_POD_SHRINK = 78
+EXIT_SUPERVISOR_GAVE_UP = 79
+
+# Event-log / report names for the typed codes (supervisor/events.py,
+# tools/runs.py supervision timeline).
+NAMES = {
+    EXIT_OK: "ok",
+    EXIT_WATCHDOG_STALL: "watchdog_stall",
+    EXIT_PREEMPTED: "preempted",
+    EXIT_POD_DEGRADED: "pod_degraded",
+    EXIT_NUMERIC: "numeric_abort",
+    EXIT_POD_SHRINK: "pod_shrink_ready",
+    EXIT_SUPERVISOR_GAVE_UP: "supervisor_gave_up",
+}
+
+
+def describe(code) -> str:
+    """Human/event-log name for a subprocess returncode: typed contract
+    names for the codes above, `signal:SIGKILL`-style for deaths by
+    signal (negative, as subprocess reports them), `exit:<n>` for
+    untyped statuses, `unknown` for a still-running child (None)."""
+    if code is None:
+        return "unknown"
+    code = int(code)
+    if code < 0:
+        try:
+            return f"signal:{signal.Signals(-code).name}"
+        except ValueError:
+            return f"signal:{-code}"
+    return NAMES.get(code, f"exit:{code}")

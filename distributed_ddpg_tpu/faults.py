@@ -17,10 +17,11 @@ yields the same schedule.
 Grammar (';'-separated specs):
 
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
+               | 'pod' ':' proc ':' 'exit' '@' at ':' code
     component := worker | pool | shipper | prefetch | ckpt | transfer | pod
                  | numeric | serve | devactor | slice
     kind      := crash | crashloop | hang | stall | slow | ioerror | kill
-                 | nan | inf | spike | corrupt
+                 | nan | inf | spike | corrupt | exit
 
 `at` is 1-based: for `worker` it is the env step inside that worker's
 FIRST incarnation (a respawned worker gets a clean slate — except
@@ -68,6 +69,14 @@ Fault semantics by component:
                              not a lost peer: the pod aggregator's
                              per-host beat-time spread must attribute it
                              (obs/aggregate.py, docs/OBSERVABILITY.md §4)
+    pod:<proc>:exit@K:<code> process <proc> hard-exits with exactly
+                             <code> (0..255) at its K-th beat — typed-exit
+                             injection for supervisor drills: every
+                             exit-code branch of the contract (exits.py;
+                             incl. the 77 refuse-and-report path) is
+                             exercisable without real peer loss or NaN
+                             poisoning. os._exit, so no cleanup runs and
+                             peers still surface the loss as PodPeerLost
     numeric:grad:nan@K       the K-th guarded learner step computes against
                              a NaN-poisoned minibatch (NaN grads/TD) — the
                              guardrails probe (guardrails.py) must skip the
@@ -129,6 +138,7 @@ import dataclasses
 import os
 import random
 import signal
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -136,7 +146,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
               "pod", "numeric", "serve", "devactor", "slice")
 KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill",
-         "nan", "inf", "spike", "corrupt")
+         "nan", "inf", "spike", "corrupt", "exit")
 
 # Worker `slow` faults throttle this many consecutive env steps, then lift
 # — bounded so a chaos soak keeps making progress past the fault.
@@ -147,7 +157,7 @@ SLOW_FAULT_STEPS = 200
 # of a multi-host pod at a lockstep-beat ordinal (docs/RESILIENCE.md).
 _WORKER_KINDS = ("crash", "crashloop", "hang", "stall", "slow")
 _SITE_KINDS = ("crash", "hang", "slow", "ioerror")
-_POD_KINDS = ("kill", "hang", "slow")
+_POD_KINDS = ("kill", "hang", "slow", "exit")
 # Slice faults target one process's all-writer replay-slice writes
 # (checkpoint.write_replay_slice): `corrupt` tears the payload after the
 # digest landed, `kill` dies before any byte does.
@@ -185,10 +195,12 @@ class FaultSpec:
     kind: str
     at: int          # env step (worker) / 1-based call ordinal (site)
     duration_s: float  # slow/hang duration; resolved at parse time
+    code: int = 0    # exit-kind only: the injected typed exit status
 
     def describe(self) -> str:
         tgt = f":{self.target}" if self.target else ""
-        return f"{self.component}{tgt}:{self.kind}@{self.at}"
+        suffix = f":{self.code}" if self.kind == "exit" else ""
+        return f"{self.component}{tgt}:{self.kind}@{self.at}{suffix}"
 
 
 def _default_duration(kind: str, rng: random.Random,
@@ -314,6 +326,21 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
         except ValueError:
             raise bad("legacy actor:<id>:<step> needs two integers") from None
         return FaultSpec("worker", str(wid), "crash", step, 0.0)
+    # Typed-exit injection is the one 4-field spec: the trailing field is
+    # the exact exit status to die with (pod:<proc>:exit@<beat>:<code>).
+    code = 0
+    has_code = False
+    if len(parts) == 4 and parts[0] == "pod" and parts[2].startswith("exit@"):
+        has_code = True
+        code_str = parts.pop()
+        try:
+            code = int(code_str)
+        except ValueError:
+            raise bad(
+                f"bad exit code {code_str!r} (integer 0..255)"
+            ) from None
+        if not 0 <= code <= 255:
+            raise bad(f"exit code {code} out of range (0..255)")
     if len(parts) == 2:
         component, tail = parts[0], parts[1]
         target = ""
@@ -357,6 +384,11 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
             raise bad(
                 f"kind {kind!r} does not apply to pod (one of {_POD_KINDS})"
             )
+        if kind == "exit" and not has_code:
+            raise bad(
+                "exit needs a trailing ':<code>' "
+                "(pod:<proc>:exit@<beat>:<code>)"
+            )
         try:
             int(target)
         except ValueError:
@@ -396,7 +428,7 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
             raise bad(f"kind {kind!r} does not apply to host sites")
     if duration is None:
         duration = _default_duration(kind, rng, component)
-    return FaultSpec(component, target, kind, at, duration)
+    return FaultSpec(component, target, kind, at, duration, code)
 
 
 class FaultSite:
@@ -447,6 +479,16 @@ class FaultSite:
                 # through the collective deadline (PodPeerLost), not
                 # through any in-process signal.
                 os.kill(os.getpid(), signal.SIGKILL)
+            elif s.kind == "exit":
+                # Typed-exit injection (pod:<proc>:exit@beat:<code>):
+                # hard-exit with exactly the scripted status — the
+                # supervisor-drill lever that exercises every branch of
+                # the exit-code contract (exits.py) without real peer
+                # loss. os._exit like the kill flavor: no cleanup, and
+                # peers still see the death as PodPeerLost.
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(s.code)
             else:  # ioerror / crash
                 raise InjectedFault(
                     f"injected {s.describe()} (call #{self._count})"

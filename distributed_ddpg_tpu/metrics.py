@@ -832,6 +832,110 @@ class PodStats:
             }
 
 
+class SupervisorStats:
+    """Thread-safe pod-supervisor counters (supervisor/core.py;
+    docs/OPERATIONS.md supervisor runbook) — the `supervisor_*` family
+    the supervisor's JSONL event stream carries on its final record, so
+    a long soak's whole restart history is auditable from one line.
+    CUMULATIVE across generations, like PodStats (every event here is a
+    rare, decision-bearing transition):
+
+      supervisor_generations      pod generations launched (gen 1 counts)
+      supervisor_spawns           child processes spawned, all generations
+      supervisor_relaunches       same-membership relaunches (70/75/76 or
+                                  untyped crashes)
+      supervisor_shrinks          shrink relaunches taken on exit 78
+                                  (membership reduced to the survivors)
+      supervisor_grows            health-gated grow relaunches (stop-the-
+                                  world resize back toward full strength)
+      supervisor_backoffs         exponential-backoff waits served
+      supervisor_backoff_wait_s   total seconds spent in those waits
+      supervisor_breaker_trips    crash-loop circuit-breaker trips (each
+                                  one is terminal: the SupervisorGaveUp
+                                  report path)
+      supervisor_numeric_refusals numeric aborts (77) refused past the
+                                  supervisor_max_numeric budget
+      supervisor_probe_ready      lost-peer slots that cleared the
+                                  K-consecutive-healthy rejoin gate
+      supervisor_probe_flaps      healthy->unhealthy probe regressions
+                                  (each restarts that slot's gate)
+      supervisor_gave_up          1 once the supervisor exited through
+                                  the typed give-up path, else 0
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.generations = 0
+        self.spawns = 0
+        self.relaunches = 0
+        self.shrinks = 0
+        self.grows = 0
+        self.backoffs = 0
+        self.backoff_wait_s = 0.0
+        self.breaker_trips = 0
+        self.numeric_refusals = 0
+        self.probe_ready = 0
+        self.probe_flaps = 0
+        self.gave_up = False
+
+    def record_generation(self, nprocs: int) -> None:
+        with self._lock:
+            self.generations += 1
+            self.spawns += int(nprocs)
+
+    def record_relaunch(self) -> None:
+        with self._lock:
+            self.relaunches += 1
+
+    def record_shrink(self) -> None:
+        with self._lock:
+            self.shrinks += 1
+
+    def record_grow(self) -> None:
+        with self._lock:
+            self.grows += 1
+
+    def record_backoff(self, wait_s: float) -> None:
+        with self._lock:
+            self.backoffs += 1
+            self.backoff_wait_s = round(self.backoff_wait_s + wait_s, 3)
+
+    def record_breaker_trip(self) -> None:
+        with self._lock:
+            self.breaker_trips += 1
+            self.gave_up = True
+
+    def record_numeric_refusal(self) -> None:
+        with self._lock:
+            self.numeric_refusals += 1
+            self.gave_up = True
+
+    def record_probe_ready(self) -> None:
+        with self._lock:
+            self.probe_ready += 1
+
+    def record_probe_flap(self) -> None:
+        with self._lock:
+            self.probe_flaps += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "supervisor_generations": self.generations,
+                "supervisor_spawns": self.spawns,
+                "supervisor_relaunches": self.relaunches,
+                "supervisor_shrinks": self.shrinks,
+                "supervisor_grows": self.grows,
+                "supervisor_backoffs": self.backoffs,
+                "supervisor_backoff_wait_s": self.backoff_wait_s,
+                "supervisor_breaker_trips": self.breaker_trips,
+                "supervisor_numeric_refusals": self.numeric_refusals,
+                "supervisor_probe_ready": self.probe_ready,
+                "supervisor_probe_flaps": self.probe_flaps,
+                "supervisor_gave_up": int(self.gave_up),
+            }
+
+
 class GuardrailStats:
     """Host-side numerical-health counters (guardrails.py;
     docs/RESILIENCE.md 'Numerical health') — the `guardrail_*` family

@@ -8,6 +8,7 @@ a device runtime (the multihost gather is injected by train.py)."""
 from distributed_ddpg_tpu.obs import health
 from distributed_ddpg_tpu.obs.aggregate import PodAggregator, detect_straggler
 from distributed_ddpg_tpu.obs.exporter import ObsExporter, render_prometheus
+from distributed_ddpg_tpu.obs.probe import ProbeResult, probe_healthz
 
 __all__ = [
     "health",
@@ -15,4 +16,6 @@ __all__ = [
     "detect_straggler",
     "ObsExporter",
     "render_prometheus",
+    "ProbeResult",
+    "probe_healthz",
 ]

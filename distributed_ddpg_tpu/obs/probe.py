@@ -1,0 +1,76 @@
+"""One health probe of a (possibly remote) peer's telemetry ingress —
+the supervisor's rejoin gate input (supervisor/prober.py; ISSUE 19).
+
+`probe_healthz` answers the only question the grow decision needs:
+"would relaunching the pod with this host succeed?" It layers two
+checks, degrading honestly:
+
+  1. TCP connect to the peer's exporter port. Refused / unreachable /
+     timed out -> the host (or its network path) is still gone.
+  2. GET /healthz (obs/exporter.py). A 200 means the typed state machine
+     says `healthy`; 503 means `degraded` or `draining` — reachable but
+     NOT a rejoin candidate (a draining peer is mid-teardown; growing
+     onto it would re-lose it immediately).
+
+The documented fallback: a host whose port accepts TCP but does not
+speak HTTP (exporter disabled, or a bare nc-style liveness listener in a
+drill) counts as healthy-by-reachability — `ProbeResult.state == "tcp"`
+marks the reduced confidence so event logs can tell the two apart.
+
+Stdlib only, no jax: the supervisor process must never pay (or risk) a
+device runtime just to poll a socket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import socket
+
+# Per-probe deadline. Probes run on the supervisor's background prober
+# thread at probe_interval_s cadence — one wedged peer must delay the
+# NEXT probe, never the supervisor's child-reaping loop.
+PROBE_TIMEOUT_S = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbeResult:
+    reachable: bool   # TCP connect succeeded
+    healthy: bool     # rejoin-candidate verdict (the gate's input)
+    state: str        # healthy|degraded|draining|down|tcp|http:<status>
+    detail: str = ""  # raw body / error repr, for event-log attribution
+
+    def __bool__(self) -> bool:
+        return self.healthy
+
+
+def probe_healthz(
+    host: str, port: int, timeout_s: float = PROBE_TIMEOUT_S
+) -> ProbeResult:
+    """One probe, never raises (module docstring for the layering)."""
+    try:
+        sock = socket.create_connection((host, int(port)), timeout=timeout_s)
+        sock.close()
+    except OSError as e:
+        return ProbeResult(False, False, "down", repr(e))
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout_s)
+    try:
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+    except (OSError, http.client.HTTPException) as e:
+        # Reachable but not speaking HTTP: the documented TCP-reachability
+        # fallback (reduced confidence, state="tcp").
+        return ProbeResult(True, True, "tcp", repr(e))
+    finally:
+        conn.close()
+    try:
+        state = str(json.loads(body).get("state", ""))
+    except ValueError:
+        state = ""
+    if resp.status == 200:
+        return ProbeResult(True, True, state or "healthy", body.strip())
+    return ProbeResult(
+        True, False, state or f"http:{resp.status}", body.strip()
+    )

@@ -1,0 +1,35 @@
+"""Process-level pod supervisor (docs/OPERATIONS.md supervisor runbook;
+docs/RESILIENCE.md exit-code matrix): spawns the N training processes,
+dispatches on their typed exit codes (exits.py), and drives the elastic
+kill -> shrink -> health-gated grow cycle with no operator in the loop.
+
+  core.py    PodSupervisor: the generation loop, exit-code dispatch,
+             exponential backoff, crash-loop circuit breaker, and the
+             stop-the-world grow resize
+  prober.py  HealthProber: background /healthz polling of lost peers
+             with K-consecutive-healthy + hysteresis flap damping
+  events.py  the supervisor's own JSONL event stream (spawn/exit/shrink/
+             grow/backoff/breaker), rendered by `tools.runs summarize`
+             as a supervision timeline
+
+Stdlib only — the supervisor must outlive device-runtime crashes, so it
+never imports jax (same rule as tools/runs.py).
+"""
+
+from distributed_ddpg_tpu.supervisor.core import (
+    PodSupervisor,
+    SupervisorConfig,
+    SupervisorGaveUp,
+    classify_generation,
+)
+from distributed_ddpg_tpu.supervisor.events import EventLog
+from distributed_ddpg_tpu.supervisor.prober import HealthProber
+
+__all__ = [
+    "PodSupervisor",
+    "SupervisorConfig",
+    "SupervisorGaveUp",
+    "classify_generation",
+    "EventLog",
+    "HealthProber",
+]

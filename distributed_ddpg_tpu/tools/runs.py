@@ -425,6 +425,34 @@ def summarize_run(path: str) -> Dict[str, Any]:
             recovery[key] = {"last": vals[-1], "max": max(vals)}
     digest["recovery"] = recovery
 
+    # Supervision digest (supervisor/events.py; docs/OPERATIONS.md
+    # supervisor runbook): the event timeline verbatim, plus the
+    # cumulative supervisor_* counters off the last record that carries
+    # them (the supervisor's `final` event).
+    sup_records = kinds.get("supervisor", [])
+    if sup_records:
+        counters: Dict[str, Any] = {}
+        for r in sup_records:
+            for k, v in r.items():
+                if k.startswith("supervisor_"):
+                    counters[k] = v
+        digest["supervisor"] = {
+            "events": [
+                {
+                    k: r[k]
+                    for k in (
+                        "wall_time", "event", "gen", "proc", "code",
+                        "code_name", "members", "target", "slots",
+                        "backoff_s", "consecutive", "failures",
+                        "reason", "transition", "state", "slot",
+                    )
+                    if k in r
+                }
+                for r in sup_records
+            ],
+            "counters": counters,
+        }
+
     ev = _col(evals, "eval_return")
     if ev:
         digest["eval"] = {
@@ -556,6 +584,34 @@ def render_summary(digest: Dict[str, Any]) -> str:
             ["field", "last"],
             [[k, v["last"]] for k, v in g.items()],
         ))
+    if digest.get("supervisor"):
+        sup = digest["supervisor"]
+        out.append(
+            "\n-- supervision timeline (supervisor/; docs/OPERATIONS.md "
+            "runbook)"
+        )
+        rows = []
+        for e in sup["events"]:
+            detail_bits = []
+            for key in ("code", "code_name", "members", "target", "slots",
+                        "slot", "transition", "state", "backoff_s",
+                        "consecutive", "failures", "reason"):
+                if key in e:
+                    detail_bits.append(f"{key}={e[key]}")
+            rows.append([
+                _fmt(e.get("wall_time")),
+                e.get("event", "?"),
+                e.get("gen", ""),
+                e.get("proc", ""),
+                " ".join(detail_bits),
+            ])
+        out.append(render_table(["t(s)", "event", "gen", "proc", "detail"],
+                                rows))
+        if sup["counters"]:
+            out.append(render_table(
+                ["counter", "total"],
+                [[k, v] for k, v in sorted(sup["counters"].items())],
+            ))
     if digest.get("recovery"):
         rec = digest["recovery"]
         if any(v["max"] for v in rec.values()):

@@ -41,39 +41,17 @@ from distributed_ddpg_tpu.ops import support_auto
 from distributed_ddpg_tpu.ops.noise import OUNoise
 from distributed_ddpg_tpu.replay import make_replay
 
-# Exit-code contract (docs/RESILIENCE.md): a supervising driver must be
-# able to tell "preempted, resumable" from a real failure. SIGTERM during
-# train_jax takes one emergency checkpoint and the CLI exits
-# EXIT_PREEMPTED (EX_TEMPFAIL) — distinct from the stall watchdog's 70
-# (EX_SOFTWARE, wedged device) and from ordinary crash tracebacks.
-EXIT_PREEMPTED = 75
-# Pod-degraded exit (docs/RESILIENCE.md pod rows): a PEER process of a
-# multi-host pod died or hung mid-collective (PodPeerLost). This survivor
-# took the coordinated clean abort — pending transfer tickets failed, one
-# emergency checkpoint written — and the driver should relaunch the WHOLE
-# pod with the same checkpoint dirs: the coordinated resume election
-# (parallel/multihost.elect_resume_step) restores one common step
-# everywhere, so the pod never resumes forked.
-EXIT_POD_DEGRADED = 76
-# Numeric-health abort (docs/RESILIENCE.md 'Numerical health'): the
-# guardrails detected sustained divergence but the rollback budget is
-# exhausted (guardrail_max_rollbacks) or no manifest-valid checkpoint
-# exists to roll back to. The params are presumed poisoned, so NO
-# checkpoint is written on this path — the driver should inspect the
-# guardrail_* counters in the final JSONL record and the last retained
-# (pre-divergence) checkpoint rather than blindly relaunching.
-EXIT_NUMERIC = 77
-# Elastic-shrink-ready exit (docs/RESILIENCE.md shrink/grow state
-# machine): a pod peer was lost AND a complete, digest-verified replay
-# slice set exists under checkpoint_dir (all-writer slices,
-# docs/REPLAY_SHARDING.md) — the dead peer's experience is recoverable
-# from its last verified write. The driver may relaunch at ANY process
-# count M (including N-1, without the lost host): the resume election
-# plus slice adoption reshards replay to M and the run continues in a
-# typed `degraded` state (pod_state_degraded) until a grow restores full
-# strength. 76 remains the fallback when no verified slice set exists
-# (relaunch the whole pod; replay re-warms).
-EXIT_POD_SHRINK = 78
+# Exit-code contract: the constants — and the full per-code rationale —
+# live in distributed_ddpg_tpu/exits.py (docs/RESILIENCE.md exit-code
+# matrix). Re-exported here because train is the historical import site
+# (tests, chaos children, operator scripts all say
+# `from distributed_ddpg_tpu.train import EXIT_...`).
+from distributed_ddpg_tpu.exits import (  # noqa: F401  (re-export)
+    EXIT_NUMERIC,
+    EXIT_POD_DEGRADED,
+    EXIT_POD_SHRINK,
+    EXIT_PREEMPTED,
+)
 
 # Shutdown reap bound for the async eval thread: evals run whole episodes,
 # so teardown grants them real time to finish, but a wedged env must not
@@ -2545,12 +2523,13 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         t = eval_thread["t"]
         if t is not None:
             t.join(timeout=_EVAL_JOIN_S)
-        if obs_server is not None and pod_lost[0] is None:
-            # Clean exits stop the ingress; a pod abort deliberately keeps
-            # it serving — /healthz must answer `degraded` through the
-            # abort window (pod_degraded_exit's rank-0 linger exists
-            # precisely so supervisors can scrape the verdict before the
-            # process disappears).
+        if obs_server is not None and pod_lost[0] is None and not preempt.is_set():
+            # Clean exits stop the ingress; a pod abort OR a preemption
+            # deliberately keeps it serving — /healthz must answer through
+            # the teardown window (pod_degraded_exit's rank-0 linger, the
+            # post-SIGTERM checkpoint flush) so a supervisor can scrape
+            # the draining verdict before the process disappears. The
+            # server thread is a daemon; process exit reaps it.
             obs_server.stop()
         if is_multi:
             # Disarm the module-level pod deadline: a later single-process
@@ -2655,6 +2634,7 @@ def pod_degraded_exit(linger_s: float = 10.0, code: int = EXIT_POD_DEGRADED) -> 
     client answers with LOG(FATAL), terminating survivors still writing
     THEIR emergency checkpoints. The aborts start near-simultaneously
     (same missed collective), so a short linger lets the peers finish."""
+    drain_for_pod_exit(code)
     try:
         import jax
 
@@ -2665,6 +2645,28 @@ def pod_degraded_exit(linger_s: float = 10.0, code: int = EXIT_POD_DEGRADED) -> 
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(code)
+
+
+def drain_for_pod_exit(code: int = EXIT_POD_DEGRADED) -> None:
+    """Latch /healthz into `draining`, carrying the pod-abort verdict.
+
+    The abort is terminal from here — the ingress (left serving through
+    the linger window by train_jax's teardown) must answer a supervisor's
+    scrape with "winding down, and THIS is why" (state=draining, the
+    degraded reasons — e.g. pod_peer_lost — preserved in the snapshot),
+    not a degraded-looking process it might still route around. drain()
+    is first-wins, so a SIGTERM that already latched `preempted` keeps
+    its attribution. Factored out of pod_degraded_exit so the linger
+    contract is testable without os._exit (tests/test_obs.py)."""
+    try:
+        from distributed_ddpg_tpu.obs import health
+
+        _state, reasons = health.get().state()
+        health.get().drain(
+            "; ".join(reasons) if reasons else f"pod abort (exit {code})"
+        )
+    except Exception:
+        pass  # diagnostics must never block the documented exit
 
 
 def _eval_numpy(policy, config: DDPGConfig, spec, episodes: Optional[int] = None) -> float:

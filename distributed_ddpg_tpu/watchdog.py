@@ -65,7 +65,9 @@ from typing import Callable, Optional
 
 from distributed_ddpg_tpu import trace
 
-_EXIT_CODE = 70  # EX_SOFTWARE: internal failure, distinguishable from OOM/kill
+# EX_SOFTWARE: internal failure, distinguishable from OOM/kill. The code
+# itself lives in the one-place exit contract (exits.py).
+from distributed_ddpg_tpu.exits import EXIT_WATCHDOG_STALL as _EXIT_CODE
 
 # stop() reap bound for the watchdog thread. The thread polls _stop every
 # poll tick, so this only trips when the watchdog itself is wedged mid-

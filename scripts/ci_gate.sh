@@ -86,7 +86,13 @@
 #              machine, /metrics + /healthz + /trace ingress, straggler
 #              detection, merge-trace, and the schema-drift pin run on
 #              CPU before any bench JSON is read (OBS_FULL=1 adds the
-#              slow 2-process scrape/peer-loss/merge drill). All flags
+#              slow 2-process scrape/peer-loss/merge drill).
+#   --supervise  run scripts/supervisor_smoke.sh (the pod-supervisor
+#              smoke, docs/OPERATIONS.md runbook): exit-code contract,
+#              breaker/backoff/prober units, and the scripted-children
+#              shrink->grow cycle on CPU before any bench JSON is read
+#              (SUPERVISE_FULL=1 adds the slow supervised 2-process
+#              kill -> auto-shrink -> auto-grow gloo drill). All flags
 #              compose: `ci_gate.sh --lint --programs --obs cand.json`.
 set -euo pipefail
 
@@ -97,10 +103,11 @@ while :; do
         --programs) "$repo_root/scripts/proganalyze_gate.sh"; shift ;;
         --elastic) "$repo_root/scripts/elastic_smoke.sh"; shift ;;
         --obs) "$repo_root/scripts/obs_smoke.sh"; shift ;;
+        --supervise) "$repo_root/scripts/supervisor_smoke.sh"; shift ;;
         *) break ;;
     esac
 done
-candidate="${1:?usage: ci_gate.sh [--lint] [--programs] [--elastic] [--obs] <candidate.json> [baseline.json]}"
+candidate="${1:?usage: ci_gate.sh [--lint] [--programs] [--elastic] [--obs] [--supervise] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
 keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,superstep_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s}"
 

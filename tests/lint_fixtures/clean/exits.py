@@ -1,0 +1,6 @@
+"""The one-place exemption: a module named exits.py may spell the typed
+codes as literals — it IS the contract everyone else imports."""
+
+EXIT_PREEMPTED = 75
+EXIT_POD_SHRINK = 78
+EXIT_SUPERVISOR_GAVE_UP = 79

@@ -1,0 +1,13 @@
+"""Typed exits routed through the named constants: no findings.
+An untyped shell status (sys.exit(2)) is not the contract's business."""
+import sys
+
+from exits import EXIT_PREEMPTED
+
+
+def stop(code=EXIT_PREEMPTED):
+    sys.exit(code)
+
+
+def usage_error():
+    sys.exit(2)

@@ -1,0 +1,14 @@
+"""exit-code-literal fixtures: the typed exit codes spelled as bare
+literals instead of the named constants from distributed_ddpg_tpu.exits.
+Three findings: one shadowing EXIT_* assignment, two bare-literal exits.
+"""
+import os
+import sys
+
+_EXIT_CODE = 70  # BAD: local exit-code constant shadows the contract
+
+
+def abandon(pod_shrink_ready):
+    if pod_shrink_ready:
+        os._exit(78)  # BAD: bare typed code in os._exit
+    sys.exit(75)  # BAD: bare typed code in sys.exit

@@ -53,35 +53,43 @@ def main() -> None:
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "chunk"
 
-    # Exercise the production bootstrap via its env-var path.
-    os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
-    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
-    os.environ["JAX_PROCESS_ID"] = str(pid)
+    if nprocs > 1:
+        # Exercise the production bootstrap via its env-var path.
+        os.environ["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+        os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+        os.environ["JAX_PROCESS_ID"] = str(pid)
 
-    # The multiprocess CPU backend needs an explicit collectives transport
-    # (the Gloo the module docstring's 'Gloo here, DCN on a pod' refers
-    # to): without it, cross-process computations fail with "Multiprocess
-    # computations aren't implemented on the CPU backend". Set before the
-    # backend is created, and only on the actual child path — gloo setup
-    # requires a distributed client, so a single-process import of this
-    # module (the parity oracle) must not inherit it.
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # The multiprocess CPU backend needs an explicit collectives
+        # transport (the Gloo the module docstring's 'Gloo here, DCN on a
+        # pod' refers to): without it, cross-process computations fail
+        # with "Multiprocess computations aren't implemented on the CPU
+        # backend". Set before the backend is created, and only on the
+        # actual child path — gloo setup requires a distributed client,
+        # so a single-process import of this module (the parity oracle)
+        # must not inherit it.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     from distributed_ddpg_tpu.parallel import multihost
 
-    assert multihost.initialize() is True
-    info = multihost.process_info()
-    assert info["process_count"] == nprocs, info
-    assert info["global_device_count"] == 2 * nprocs, info
+    if nprocs > 1:
+        assert multihost.initialize() is True
+        info = multihost.process_info()
+        assert info["process_count"] == nprocs, info
+        assert info["global_device_count"] == 2 * nprocs, info
 
-    # Startup hardening (ISSUE 6 satellite): rendezvous once with a
-    # generous grace so a peer still paying backend-init/import cost
-    # under box load doesn't turn the first real collective into a
-    # "startup heartbeat timeout" flake. Distinct from (and much larger
-    # than) any steady-state collective deadline the mode then arms.
-    multihost.startup_barrier(
-        float(os.environ.get("POD_STARTUP_GRACE_S", "240"))
-    )
+        # Startup hardening (ISSUE 6 satellite): rendezvous once with a
+        # generous grace so a peer still paying backend-init/import cost
+        # under box load doesn't turn the first real collective into a
+        # "startup heartbeat timeout" flake. Distinct from (and much
+        # larger than) any steady-state collective deadline the mode
+        # then arms.
+        multihost.startup_barrier(
+            float(os.environ.get("POD_STARTUP_GRACE_S", "240"))
+        )
+    # nprocs == 1: no distributed bootstrap, no gloo, no barrier — the
+    # shape of a supervisor's shrunk-to-one generation (ISSUE 19). The
+    # run behaves like the elastic test's in-process M=1 adoption phase
+    # (tests/test_pod.py test_two_process_elastic_shrink_then_grow).
 
     import numpy as np
 

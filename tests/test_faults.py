@@ -120,6 +120,60 @@ def test_config_validates_fault_grammar():
 
 
 # ---------------------------------------------------------------------------
+# typed-exit injection: pod:<proc>:exit@<beat>:<code> (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_pod_exit_grammar_round_trip():
+    p = FaultPlan.parse("pod:1:exit@5:77")
+    (s,) = p.specs
+    assert (s.component, s.target, s.kind, s.at, s.code) == \
+        ("pod", "1", "exit", 5, 77)
+    assert s.describe() == "pod:1:exit@5:77"
+    # The plan repr round-trips through the same describe().
+    assert "pod:1:exit@5:77" in repr(p)
+    # Composes with the rest of the grammar.
+    both = FaultPlan.parse("pod:0:exit@3:78; worker:1:crash@100")
+    assert {s.kind for s in both.specs} == {"exit", "crash"}
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "pod:1:exit@5",            # exit needs the trailing :<code>
+        "pod:1:exit@5:banana",     # non-integer code
+        "pod:1:exit@5:300",        # out of 0..255
+        "pod:1:exit@5:-1",         # negative is a signal, not a status
+        "worker:1:exit@5:77",      # pod-only kind
+        "pod:1:kill@5:77",         # the 4-field form is exit-only
+    ],
+)
+def test_parse_pod_exit_rejects(bad):
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultPlan.parse(bad)
+
+
+def test_pod_exit_fires_os_exit_with_scripted_code(monkeypatch):
+    """The exit kind hard-exits with EXACTLY the scripted status at the
+    scripted beat ordinal, on the targeted process only — the lever that
+    drills every supervisor branch (exits.py) without real peer loss."""
+    calls = []
+    monkeypatch.setattr(os, "_exit", lambda code: calls.append(code))
+    plan = FaultPlan.parse("pod:1:exit@3:77")
+    bystander = plan.pod_site(0)
+    victim = plan.pod_site(1)
+    for _ in range(4):
+        bystander.tick()
+    assert calls == []                   # wrong process: never fires
+    victim.tick()
+    victim.tick()
+    assert calls == []                   # beats 1-2: not yet
+    victim.tick()                        # beat 3: the scripted exit
+    assert calls == [77]
+    assert victim.fired == ["pod:1:exit@3:77"]
+
+
+# ---------------------------------------------------------------------------
 # checkpoint: retry, manifest, fallback chain
 # ---------------------------------------------------------------------------
 

@@ -40,6 +40,7 @@ EXPECTED_RULES = {
     "lock-discipline",
     "observability-drift",
     "recompile-hazard",
+    "exit-code-literal",
 }
 
 
@@ -79,9 +80,10 @@ def test_dirty_tree_fires_every_rule_with_expected_counts():
         "lock-discipline": 4,
         "observability-drift": 3,
         "recompile-hazard": 5,
+        "exit-code-literal": 3,
     }
     # Nothing in the dirty tree is suppressed — every finding gates.
-    assert len(result.unsuppressed) == len(result.findings) == 30
+    assert len(result.unsuppressed) == len(result.findings) == 33
 
 
 def test_dirty_tree_known_bad_locations():
@@ -115,6 +117,11 @@ def test_dirty_tree_known_bad_locations():
     assert len(lock_lines) == 4
     # ...and the blocking queue.get is among them, by name.
     assert any("q.get()" in f.message for f in by_rule["lock-discipline"])
+    # exit-code-literal: both the call form and the shadowing assignment.
+    exit_msgs = [f.message for f in by_rule["exit-code-literal"]]
+    assert any("78" in m and "_exit()" in m for m in exit_msgs)
+    assert any("_EXIT_CODE" in m and "70" in m for m in exit_msgs)
+    assert {f.path for f in by_rule["exit-code-literal"]} == {"runner.py"}
 
 
 def test_doc_coupled_checks_silent_without_a_docs_tree(tmp_path):
@@ -392,7 +399,7 @@ def test_json_schema(tmp_path):
     obj = json.loads(out.read_text())
     assert obj["version"] == 1
     assert set(obj["counts"]) == {"files", "findings", "suppressed"}
-    assert obj["counts"]["findings"] == 30
+    assert obj["counts"]["findings"] == 33
     assert obj["counts"]["suppressed"] == 0
     assert sorted(obj["rules"]) == sorted(r.name for r in RULES)
     assert isinstance(obj["elapsed_s"], float)

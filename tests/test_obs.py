@@ -454,6 +454,42 @@ def test_watchdog_stall_drains_health():
         wd.stop()
 
 
+def test_pod_abort_linger_serves_latched_draining_verdict():
+    """ISSUE 19 satellite: during the pod-abort linger window (rank 0
+    keeps its ingress up briefly so one last scrape can read the
+    verdict), /healthz must return 503 with state `draining` and the
+    LATCHED degraded reason — not a fresh `healthy`. The drain handshake
+    is train.drain_for_pod_exit, factored out of pod_degraded_exit so
+    this contract is testable without os._exit."""
+    from distributed_ddpg_tpu import train
+
+    health.get().note("pod peer lost: process 1")
+    ex = ObsExporter(0).start()
+    try:
+        train.drain_for_pod_exit(train.EXIT_POD_SHRINK)
+        code, _, body = _http(ex.url("/healthz"))
+        assert code == 503
+        snap = json.loads(body)
+        assert snap["state"] == "draining"
+        assert any("pod peer lost" in r for r in snap["reasons"])
+        # Latched: a later recovery signal must NOT un-drain the verdict.
+        health.get().note("pod peer lost: process 1", active=False)
+        code, _, body = _http(ex.url("/healthz"))
+        assert code == 503
+        assert json.loads(body)["state"] == "draining"
+    finally:
+        ex.stop()
+
+
+def test_drain_for_pod_exit_without_prior_reason_names_the_code():
+    from distributed_ddpg_tpu import train
+
+    train.drain_for_pod_exit(train.EXIT_POD_DEGRADED)
+    state, reasons = health.get().state()
+    assert state == health.DRAINING
+    assert reasons == ["pod abort (exit 76)"]
+
+
 @pytest.mark.skipif(not hasattr(signal, "SIGUSR2"),
                     reason="platform has no SIGUSR2")
 def test_sigusr2_reexports_live_trace(tmp_path):

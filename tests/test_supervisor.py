@@ -1,0 +1,670 @@
+"""Pod supervisor tests (distributed_ddpg_tpu/supervisor/; ISSUE 19;
+docs/OPERATIONS.md "Pod supervisor runbook").
+
+Tier-1 (fast, no jax in the children): the typed exit-code contract
+(exits.py), the pure generation classifier + backoff curve, the JSONL
+event log, the rejoin prober's damping state machine driven
+synchronously, /healthz probing against a real ObsExporter, and the
+supervisor's decision paths exercised end-to-end with scripted stdlib
+children — crash-loop breaker, numeric refusal, preemption, and the
+full shrink -> probe-gated grow -> success cycle in seconds.
+
+Slow: the gloo acceptance drill — a real 2-process podtrain pod under
+the supervisor, `pod:1:kill@12` in generation 1 only, auto-shrink to a
+degraded singleton, health-gated stop-the-world grow back to 2, clean
+completion. Zero operator actions between kill and PASS.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_ddpg_tpu import exits
+from distributed_ddpg_tpu.metrics import SupervisorStats
+from distributed_ddpg_tpu.obs import health
+from distributed_ddpg_tpu.obs.exporter import ObsExporter
+from distributed_ddpg_tpu.obs.probe import ProbeResult, probe_healthz
+from distributed_ddpg_tpu.supervisor import (
+    EventLog,
+    HealthProber,
+    PodSupervisor,
+    SupervisorConfig,
+    SupervisorGaveUp,
+    classify_generation,
+)
+from distributed_ddpg_tpu.supervisor.core import backoff_for
+from distributed_ddpg_tpu.tools import runs as runs_cli
+from distributed_ddpg_tpu.tools import supervise as supervise_cli
+
+TESTS = Path(__file__).resolve().parent
+REPO = str(TESTS.parent)
+CHILD = TESTS / "multihost_child.py"
+
+
+@pytest.fixture(autouse=True)
+def _healthy_singleton():
+    health.get().reset()
+    yield
+    health.get().reset()
+
+
+# --------------------------------------------------------------------------
+# exits.py: the one-place contract
+# --------------------------------------------------------------------------
+
+
+def test_exit_contract_values_are_the_documented_ones():
+    assert exits.EXIT_OK == 0
+    assert exits.EXIT_WATCHDOG_STALL == 70
+    assert exits.EXIT_PREEMPTED == 75
+    assert exits.EXIT_POD_DEGRADED == 76
+    assert exits.EXIT_NUMERIC == 77
+    assert exits.EXIT_POD_SHRINK == 78
+    assert exits.EXIT_SUPERVISOR_GAVE_UP == 79
+    # Every typed code has an event-log name, and they are unique.
+    assert len(set(exits.NAMES.values())) == len(exits.NAMES) == 7
+
+
+def test_describe_covers_typed_signal_untyped_and_unknown():
+    assert exits.describe(exits.EXIT_POD_SHRINK) == "pod_shrink_ready"
+    assert exits.describe(0) == "ok"
+    assert exits.describe(-signal.SIGKILL) == "signal:SIGKILL"
+    assert exits.describe(-signal.SIGTERM) == "signal:SIGTERM"
+    assert exits.describe(1) == "exit:1"
+    assert exits.describe(None) == "unknown"
+
+
+def test_train_reexports_are_the_same_objects():
+    # train.py re-exports the constants (its public API predates
+    # exits.py); drift between the two would fork the contract.
+    train = pytest.importorskip("distributed_ddpg_tpu.train")
+    assert train.EXIT_PREEMPTED is exits.EXIT_PREEMPTED
+    assert train.EXIT_POD_DEGRADED is exits.EXIT_POD_DEGRADED
+    assert train.EXIT_POD_SHRINK is exits.EXIT_POD_SHRINK
+    assert train.EXIT_NUMERIC is exits.EXIT_NUMERIC
+
+
+# --------------------------------------------------------------------------
+# pure decision logic: classifier + backoff
+# --------------------------------------------------------------------------
+
+
+def test_classify_generation_matrix():
+    E = exits
+    # all clean -> success
+    assert classify_generation([0, 0]) == "success"
+    # numeric outranks EVERYTHING, including a pending resize
+    assert classify_generation([0, E.EXIT_NUMERIC]) == "numeric"
+    assert classify_generation(
+        [E.EXIT_NUMERIC, E.EXIT_POD_SHRINK]) == "numeric"
+    assert classify_generation([E.EXIT_NUMERIC], grow_pending=True) \
+        == "numeric"
+    # self-initiated resize: the SIGTERM exits carry no new information
+    assert classify_generation([E.EXIT_PREEMPTED], grow_pending=True) \
+        == "resize"
+    # shrink needs a 78 AND somebody actually dead-by-signal
+    assert classify_generation(
+        [E.EXIT_POD_SHRINK, -signal.SIGKILL]) == "shrink"
+    assert classify_generation(
+        [E.EXIT_POD_SHRINK, None, 0]) == "shrink"
+    # all-78, nobody dead: lockstep abort -> full-strength relaunch
+    assert classify_generation(
+        [E.EXIT_POD_SHRINK, E.EXIT_POD_SHRINK]) == "relaunch"
+    # the relaunch family
+    for code in (E.EXIT_WATCHDOG_STALL, E.EXIT_PREEMPTED,
+                 E.EXIT_POD_DEGRADED, 1):
+        assert classify_generation([code, 0]) == "relaunch", code
+    assert classify_generation([-signal.SIGKILL, -signal.SIGKILL]) \
+        == "relaunch"
+
+
+def test_backoff_doubles_and_caps():
+    assert backoff_for(0, 1.0, 60.0) == 0.0
+    assert backoff_for(1, 1.0, 60.0) == 1.0
+    assert backoff_for(2, 1.0, 60.0) == 2.0
+    assert backoff_for(4, 1.0, 60.0) == 8.0
+    assert backoff_for(50, 1.0, 60.0) == 60.0  # capped
+
+
+# --------------------------------------------------------------------------
+# event log
+# --------------------------------------------------------------------------
+
+
+def test_event_log_round_trips_jsonl(tmp_path):
+    path = str(tmp_path / "sup.jsonl")
+    log = EventLog(path)
+    log.emit("spawn", gen=1, proc=0, members=2)
+    log.emit("exit", gen=1, proc=0, code=78,
+             code_name="pod_shrink_ready")
+    log.emit("shrink", gen=1, members=2, target=1)
+    log.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert [r["event"] for r in recs] == ["spawn", "exit", "shrink"]
+    assert all(r["kind"] == "supervisor" for r in recs)
+    assert all("wall_time" in r and "t_unix" in r for r in recs)
+    assert log.by_event("shrink")[0]["target"] == 1
+    # path='' keeps the in-memory mirror working with no file
+    mem = EventLog("")
+    mem.emit("start", target=2)
+    assert mem.by_event("start")[0]["target"] == 2
+    mem.close()
+
+
+# --------------------------------------------------------------------------
+# rejoin prober: damping state machine (synchronous poll_once)
+# --------------------------------------------------------------------------
+
+
+class _ScriptedProbe:
+    """probe_fn stand-in: pops the next scripted verdict per call."""
+
+    def __init__(self, verdicts):
+        self.verdicts = list(verdicts)
+
+    def __call__(self, host, port):
+        healthy = self.verdicts.pop(0) if self.verdicts else True
+        return ProbeResult(healthy, healthy,
+                           "healthy" if healthy else "down")
+
+
+def _prober(verdicts, *, k=3, hysteresis=0.0, transitions=None):
+    p = HealthProber(
+        {1: ("127.0.0.1", 1)},
+        interval_s=0.01,
+        healthy_k=k,
+        hysteresis_s=hysteresis,
+        probe_fn=_ScriptedProbe(verdicts),
+        on_transition=(
+            (lambda s, t, r: transitions.append((s, t)))
+            if transitions is not None else None
+        ),
+    )
+    p.set_watched([1])
+    return p
+
+
+def test_prober_requires_k_consecutive_healthy():
+    transitions = []
+    p = _prober([True, True, False, True, True, True],
+                transitions=transitions)
+    for _ in range(2):
+        p.poll_once()
+    assert p.ready_slots() == []          # 2 < K=3
+    p.poll_once()                          # flap resets the count
+    assert p.ready_slots() == []
+    for _ in range(3):
+        p.poll_once()
+    assert p.ready_slots() == [1]
+    assert transitions == [(1, "up"), (1, "flap"), (1, "up"), (1, "ready")]
+
+
+def test_prober_hysteresis_gates_a_fast_k(monkeypatch):
+    # K satisfied immediately but the slot hasn't been continuously
+    # healthy for hysteresis_s: not ready until the clock catches up.
+    p = _prober([True] * 10, k=2, hysteresis=3600.0)
+    for _ in range(5):
+        p.poll_once()
+    assert p.ready_slots() == []
+    # Re-anchor the hysteresis clock into the past: now it clears.
+    with p._lock:
+        p._watched[1].last_unhealthy -= 7200.0
+    assert p.ready_slots() == [1]
+
+
+def test_prober_unwatch_drops_state():
+    p = _prober([True] * 6, k=2, hysteresis=0.0)
+    p.poll_once()
+    p.poll_once()
+    assert p.ready_slots() == [1]
+    p.set_watched([])                      # slot rejoined: stop watching
+    assert p.ready_slots() == []
+    p.set_watched([1])                     # lost again: starts cold
+    p.poll_once()
+    assert p.ready_slots() == []
+
+
+# --------------------------------------------------------------------------
+# /healthz probing against a real exporter
+# --------------------------------------------------------------------------
+
+
+def test_probe_healthz_states(tmp_path):
+    ex = ObsExporter(0).start()
+    try:
+        r = probe_healthz("127.0.0.1", ex.port)
+        assert r.reachable and r.healthy and r.state == "healthy"
+        assert bool(r) is True
+        health.get().drain("pod abort (exit 78)")
+        r = probe_healthz("127.0.0.1", ex.port)
+        assert r.reachable and not r.healthy and r.state == "draining"
+        assert bool(r) is False
+    finally:
+        ex.stop()
+        health.get().reset()
+    # Stopped exporter: connection refused -> down, never raises.
+    r = probe_healthz("127.0.0.1", ex.port)
+    assert not r.reachable and not r.healthy and r.state == "down"
+
+
+# --------------------------------------------------------------------------
+# supervisor decision paths with scripted stdlib children (fast)
+# --------------------------------------------------------------------------
+
+
+def _cmd(code_or_script):
+    """command_builder for a fixed one-liner child."""
+    script = (
+        f"import sys; sys.exit({code_or_script})"
+        if isinstance(code_or_script, int) else code_or_script
+    )
+
+    def build(proc, nprocs, port, gen):
+        return [sys.executable, "-c", script], {}
+
+    return build
+
+
+def _fast_cfg(tmp_path, **kw):
+    base = dict(
+        procs=1,
+        backoff_base_s=0.01,
+        backoff_max_s=0.05,
+        breaker_failures=3,
+        breaker_window_s=60.0,
+        healthy_run_s=60.0,
+        drain_grace_s=5.0,
+        kill_grace_s=2.0,
+        event_log=str(tmp_path / "sup.jsonl"),
+        report_path=str(tmp_path / "gave_up.json"),
+    )
+    base.update(kw)
+    return SupervisorConfig(**base)
+
+
+def test_crash_loop_trips_breaker_with_typed_report(tmp_path):
+    cfg = _fast_cfg(tmp_path)
+    sup = PodSupervisor(cfg, _cmd(1))
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sup.run()
+    assert ei.value.reason == "crash_loop"
+    assert ei.value.report_path == cfg.report_path
+    report = json.loads(open(cfg.report_path).read())
+    assert report["reason"] == "crash_loop"
+    assert report["last_exit_names"] == ["exit:1"]
+    assert report["counters"]["supervisor_breaker_trips"] == 1
+    assert report["counters"]["supervisor_gave_up"] == 1
+    # 3 generations ran, each emitted spawn + exit; breaker + gave_up +
+    # final all landed in the JSONL stream.
+    events = [json.loads(line) for line in open(cfg.event_log)]
+    names = [e["event"] for e in events]
+    assert names.count("spawn") == 3
+    assert names.count("exit") == 3
+    assert "breaker" in names and "gave_up" in names
+    assert names[-1] == "final"
+    final = events[-1]
+    assert final["code"] == exits.EXIT_SUPERVISOR_GAVE_UP
+    assert final["supervisor_generations"] == 3
+
+
+def test_numeric_abort_refused_by_default(tmp_path):
+    sup = PodSupervisor(_fast_cfg(tmp_path), _cmd(77))
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sup.run()
+    assert ei.value.reason == "numeric_abort"
+    assert sup.stats.snapshot()["supervisor_numeric_refusals"] == 1
+    assert sup.stats.snapshot()["supervisor_generations"] == 1  # no retry
+    assert "guardrail_" in ei.value.report["detail"]
+
+
+def test_numeric_budget_allows_counted_relaunches(tmp_path):
+    sup = PodSupervisor(_fast_cfg(tmp_path, max_numeric=2), _cmd(77))
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sup.run()
+    assert ei.value.reason == "numeric_abort"
+    snap = sup.stats.snapshot()
+    assert snap["supervisor_generations"] == 3   # 2 budgeted relaunches
+    assert snap["supervisor_relaunches"] == 2
+    reasons = [e["reason"] for e in sup.events.by_event("relaunch")]
+    assert reasons == ["numeric_abort (1/2)", "numeric_abort (2/2)"]
+
+
+def test_healthy_generation_resets_the_breaker(tmp_path):
+    # Children die instantly, but healthy_run_s=0 classifies every
+    # generation as long-lived: consecutive resets, backoff stays 0, the
+    # window never fills — the supervisor keeps relaunching until the
+    # generation budget (the test's own bound) gives up.
+    cfg = _fast_cfg(tmp_path, healthy_run_s=0.0, max_generations=6)
+    sup = PodSupervisor(cfg, _cmd(1))
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sup.run()
+    assert ei.value.reason == "generation_budget"
+    snap = sup.stats.snapshot()
+    assert snap["supervisor_generations"] == 6
+    assert snap["supervisor_backoffs"] == 0      # never a failing streak
+
+
+def test_request_stop_preempts_and_drains(tmp_path):
+    cfg = _fast_cfg(tmp_path, kill_grace_s=5.0)
+    sup = PodSupervisor(
+        cfg, _cmd("import time; time.sleep(600)"))
+    rc = {}
+    t = threading.Thread(target=lambda: rc.update(v=sup.run()))
+    t.start()
+    # Wait for the child to be spawned, then preempt the supervisor.
+    deadline = time.monotonic() + 10.0
+    while not sup.events.by_event("spawn"):
+        assert time.monotonic() < deadline, "child never spawned"
+        time.sleep(0.02)
+    sup.request_stop()
+    t.join(timeout=15.0)
+    assert not t.is_alive()
+    assert rc["v"] == exits.EXIT_PREEMPTED
+    # The sleeping child was SIGTERMed (default handler: death by signal).
+    (exit_ev,) = sup.events.by_event("exit")
+    assert exit_ev["code_name"] == "signal:SIGTERM"
+
+
+def test_spawn_failure_feeds_breaker_not_crash(tmp_path):
+    def build(proc, nprocs, port, gen):
+        return ["/nonexistent/binary/for/this/test"], {}
+
+    sup = PodSupervisor(_fast_cfg(tmp_path), build)
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sup.run()
+    assert ei.value.reason == "crash_loop"
+    assert any(
+        e["code_name"].startswith("spawn_error")
+        for e in sup.events.by_event("exit")
+    )
+
+
+_CYCLE_CHILD = textwrap.dedent("""\
+    import os, signal, sys, time
+    proc, gen = int(sys.argv[1]), int(sys.argv[2])
+    if gen == 1:
+        if proc == 1:
+            os.kill(os.getpid(), signal.SIGKILL)   # the lost peer
+        time.sleep(0.4)                            # peer-loss detection
+        sys.exit(78)                               # slices verified
+    elif gen == 2:
+        # Degraded singleton: run until the grow SIGTERM, take the
+        # emergency-checkpoint exit.
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))
+        time.sleep(600)
+        sys.exit(75)
+    else:
+        sys.exit(0)                                # full strength again
+""")
+
+
+def test_full_shrink_probe_grow_cycle(tmp_path):
+    """The whole autonomous story, in seconds, with scripted children:
+    gen1 (N=2) loses proc 1 -> survivor exits 78 -> shrink to M=1;
+    the stand-in peer's /healthz (a real ObsExporter) clears the
+    K+hysteresis gate -> stop-the-world SIGTERM -> grow back to N=2;
+    gen3 completes -> supervisor exits 0. Then `tools.runs summarize`
+    renders the event log as a supervision timeline."""
+    child = tmp_path / "child.py"
+    child.write_text(_CYCLE_CHILD)
+
+    def build(proc, nprocs, port, gen):
+        return [sys.executable, str(child), str(proc), str(gen)], {}
+
+    ex = ObsExporter(0).start()   # the lost peer's stand-in ingress
+    cfg = _fast_cfg(
+        tmp_path,
+        procs=2,
+        drain_grace_s=10.0,
+        kill_grace_s=5.0,
+        probe_interval_s=0.05,
+        probe_healthy_k=2,
+        probe_hysteresis_s=0.1,
+        grow_defer_s=0.5,
+        max_generations=6,
+    )
+    sup = PodSupervisor(
+        cfg, build, probe_targets={1: ("127.0.0.1", ex.port)}
+    )
+    try:
+        rc = sup.run()
+    finally:
+        ex.stop()
+    assert rc == 0
+
+    shrinks = sup.events.by_event("shrink")
+    grows = sup.events.by_event("grow")
+    assert len(shrinks) == 1 and shrinks[0]["members"] == 2 \
+        and shrinks[0]["target"] == 1
+    assert len(grows) == 1 and grows[0]["members"] == 1 \
+        and grows[0]["target"] == 2
+    assert sup.events.by_event("grow_initiated")[0]["slots"] == [1]
+    # The prober's edges made it into the stream (up -> ready at least).
+    transitions = [e["transition"] for e in sup.events.by_event("probe")]
+    assert "up" in transitions and "ready" in transitions
+    snap = sup.stats.snapshot()
+    assert snap["supervisor_shrinks"] == 1
+    assert snap["supervisor_grows"] == 1
+    assert snap["supervisor_probe_ready"] >= 1
+    assert snap["supervisor_gave_up"] == 0
+    # Generation 3 was full strength again.
+    gen3 = [e for e in sup.events.by_event("spawn") if e["gen"] == 3]
+    assert len(gen3) == 2
+
+    # The event log is a first-class run artifact: summarize renders it.
+    digest = runs_cli.summarize_run(cfg.event_log)
+    assert digest["supervisor"]["counters"]["supervisor_grows"] == 1
+    text = runs_cli.render_summary(digest)
+    assert "supervision timeline" in text
+    assert "shrink" in text and "grow" in text
+
+
+def test_cli_parses_and_gives_up_typed(tmp_path):
+    """End-to-end through the tools.supervise CLI surface: flag
+    plumbing, {gen} substitution in --env, and the typed gave-up exit."""
+    rc = supervise_cli.main(
+        [
+            "--procs", "1",
+            "--backoff-base", "0.01",
+            "--breaker-failures", "2",
+            "--breaker-window", "60",
+            "--event-log", str(tmp_path / "cli.jsonl"),
+            "--report", str(tmp_path / "cli_report.json"),
+            "--child-logs", str(tmp_path / "children"),
+            "--env", "SUPERVISE_TEST_GEN={gen}",
+            "--",
+            sys.executable, "-c",
+            "import os, sys; sys.exit(int(os.environ"
+            "['SUPERVISE_TEST_GEN']) * 0 + 1)",
+        ]
+    )
+    assert rc == exits.EXIT_SUPERVISOR_GAVE_UP
+    report = json.loads(open(tmp_path / "cli_report.json").read())
+    assert report["reason"] == "crash_loop"
+    # Child stdout/stderr landed in per-generation capture files.
+    logs = sorted(os.listdir(tmp_path / "children"))
+    assert logs == ["gen1_proc0.log", "gen2_proc0.log"]
+
+
+def test_cli_rejects_missing_command_and_bad_env(capsys):
+    assert supervise_cli.main(["--procs", "1"]) == 2
+    with pytest.raises(SystemExit):
+        supervise_cli.main(
+            ["--procs", "1", "--env", "NOEQUALS", "--", "true"]
+        )
+
+
+# --------------------------------------------------------------------------
+# the gloo acceptance drill (slow)
+# --------------------------------------------------------------------------
+
+
+def _drill_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _drill_flaked(event_log, child_log_dir) -> bool:
+    """The known multiprocess-CPU gloo stream race (test_pod._infra_flake,
+    docs/RESILIENCE.md): SIGABRT or the gloo abort markers in a child
+    capture. Not the supervision contract under test — retry fresh."""
+    events = _drill_events(event_log)
+    if any(
+        e["event"] == "exit" and e.get("code") == -signal.SIGABRT
+        for e in events
+    ):
+        return True
+    for name in os.listdir(child_log_dir):
+        text = (Path(child_log_dir) / name).read_text(errors="replace")
+        if "gloo::EnforceNotMet" in text or "Gloo all-reduce failed" in text:
+            return True
+    return False
+
+
+@pytest.mark.slow
+def test_supervised_two_process_elastic_drill(tmp_path):
+    """ISSUE 19 acceptance: the unattended version of test_pod.py's
+    elastic drill. The supervisor launches a 2-process podtrain pod;
+    `pod:1:kill@12` (armed on every full-strength pre-shrink generation,
+    so gloo infra flakes can't outrun it) kills a
+    writer past a checkpoint cadence; the survivor exits 78; the
+    supervisor auto-shrinks to a degraded singleton; a stand-in healthy
+    /healthz for the lost slot clears the probe gate; the supervisor
+    SIGTERMs the singleton at a checkpoint boundary and relaunches at
+    N=2, which adopts the 1-writer slice set, reports grows=1 with a
+    healthy state, and completes its budget. Zero operator actions; the
+    event log carries >=1 shrink and >=1 grow."""
+    for attempt in range(3):
+        ckpt_dir = tmp_path / f"ckpt{attempt}"
+        child_logs = tmp_path / f"children{attempt}"
+        event_log = str(tmp_path / f"sup{attempt}.jsonl")
+        os.makedirs(child_logs, exist_ok=True)
+        sup_ref = []
+
+        def build(proc, nprocs, port, gen,
+                  _ckpt=str(ckpt_dir), _base=tmp_path, _attempt=attempt,
+                  _ref=sup_ref):
+            log_dir = _base / f"logs{_attempt}_gen{gen}"
+            os.makedirs(log_dir, exist_ok=True)
+            env = {
+                "PYTHONPATH": REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+                "POD_RUNTIME_HEARTBEAT_TIMEOUT_S": "300",
+                "POD_REPLAY_SHARDING": "sharded",
+                "POD_TIMEOUT_S": "20",
+                "POD_STARTUP_GRACE_S": "120",
+                "POD_CKPT_DIR": _ckpt,
+                "POD_LOG_DIR": str(log_dir),
+            }
+            # Phase-driven env, keyed off the pod composition instead of
+            # the generation NUMBER: a gloo infra flake (docs/RESILIENCE
+            # .md) can burn whole generations before the scripted kill
+            # ever fires, so the kill must re-arm on every full-strength
+            # pre-shrink relaunch. Budgets mirror the elastic test: the
+            # pre-shrink pod and the degraded singleton never finish on
+            # their own (the kill / the grow SIGTERM end them); the
+            # grown pod's budget is already satisfied by the restored
+            # offset -> adopt + clean exit 0. Production uses
+            # --env-first for one-shot injection; this closure IS the
+            # drill's scripted chaos.
+            grown = bool(_ref and _ref[0].events.by_event("grow"))
+            if nprocs == 2 and not grown:       # phase 1: arm the kill
+                env["POD_FAULTS"] = "pod:1:kill@12"
+                env["POD_TOTAL_STEPS"] = "500000"
+                env["POD_CKPT_EVERY"] = "16"
+            elif nprocs == 1:                   # phase 2: degraded M=1
+                env["POD_TOTAL_STEPS"] = "500000"
+                # Write the 1-writer slice set promptly (the elastic
+                # test's checkpoint_every=1).
+                env["POD_CKPT_EVERY"] = "1"
+            else:                               # phase 3: grown back
+                env["POD_TOTAL_STEPS"] = "1"
+                env["POD_CKPT_EVERY"] = "16"
+            argv = [sys.executable, str(CHILD), str(proc), str(nprocs),
+                    str(port), "podtrain"]
+            return argv, env
+
+        ex = ObsExporter(0).start()   # lost slot 1's stand-in /healthz
+        cfg = SupervisorConfig(
+            procs=2,
+            backoff_base_s=0.5,
+            backoff_max_s=5.0,
+            breaker_failures=0,          # flakes retry at THIS level
+            healthy_run_s=10.0,
+            max_generations=8,
+            drain_grace_s=150.0,         # survivor needs the pod deadline
+            kill_grace_s=60.0,           # emergency checkpoint on SIGTERM
+            probe_interval_s=1.0,
+            probe_healthy_k=3,
+            probe_hysteresis_s=2.0,
+            # The singleton must adopt + write a cadence first: defer the
+            # stop-the-world resize past jax import + compile.
+            grow_defer_s=75.0,
+            event_log=event_log,
+            report_path=str(tmp_path / f"report{attempt}.json"),
+            child_log_dir=str(child_logs),
+        )
+        sup = PodSupervisor(
+            cfg, build, probe_targets={1: ("127.0.0.1", ex.port)}
+        )
+        sup_ref.append(sup)
+        rc = {}
+
+        def _run():
+            try:
+                rc.update(v=sup.run())
+            except SupervisorGaveUp as e:   # generation budget: a flake
+                rc.update(gave_up=e.reason)  # storm — retried below
+
+        t = threading.Thread(target=_run)
+        t.start()
+        t.join(timeout=720.0)
+        if t.is_alive():                 # wedged (infra): drain + retry
+            sup.request_stop()
+            t.join(timeout=120.0)
+        ex.stop()
+        health.get().reset()
+        if rc.get("v") == 0 and not t.is_alive():
+            break
+        assert _drill_flaked(event_log, child_logs), (
+            f"drill failed for a non-flake reason: rc={rc!r}\n"
+            + "\n".join(map(json.dumps, _drill_events(event_log)))
+        )
+    assert rc.get("v") == 0, "all attempts infra-flaked"
+
+    events = _drill_events(event_log)
+    names = [e["event"] for e in events]
+    assert names.count("shrink") >= 1, names
+    assert names.count("grow") >= 1, names
+    shrink = next(e for e in events if e["event"] == "shrink")
+    assert (shrink["members"], shrink["target"]) == (2, 1)
+    grow = next(e for e in events if e["event"] == "grow")
+    assert (grow["members"], grow["target"]) == (1, 2)
+    final = events[-1]
+    assert final["event"] == "final" and final["code"] == 0
+    assert final["supervisor_shrinks"] >= 1
+    assert final["supervisor_grows"] >= 1
+    assert final["supervisor_gave_up"] == 0
+
+    # The grown generation adopted the singleton's slice set and cleared
+    # the degraded state (the PODRESULT line in its capture). A flake can
+    # burn post-grow generations too, so read the LAST generation — with
+    # rc == 0 it is the one that completed its budget.
+    gen = max(e["gen"] for e in events if e["event"] == "spawn")
+    grown = [
+        (Path(cfg.child_log_dir) / f"gen{gen}_proc{p}.log").read_text(
+            errors="replace")
+        for p in range(2)
+    ]
+    for out in grown:
+        assert " adopted=1 " in out, out[-2000:]
+        assert " grows=1 " in out, out[-2000:]
+        assert "degraded=0" in out, out[-2000:]
