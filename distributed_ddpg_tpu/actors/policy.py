@@ -122,6 +122,16 @@ class NumpyPolicy:
             layer["b"] = flat[i : i + n].copy()
             i += n
 
+    def head(self, obs: np.ndarray) -> np.ndarray:
+        """Raw final-layer output — [mean | log_std_raw] for the SAC
+        head, pre-tanh mu otherwise. The serve path's building block
+        (serve/server.py): the server ships head rows and applies the
+        squash/sampling itself, with per-client keys."""
+        x = np.atleast_2d(obs)
+        for layer in self.layers[:-1]:
+            x = np.maximum(x @ layer["w"] + layer["b"], 0.0)
+        return x @ self.layers[-1]["w"] + self.layers[-1]["b"]
+
     def __call__(self, obs: np.ndarray) -> np.ndarray:
         x = np.atleast_2d(obs)
         for layer in self.layers[:-1]:

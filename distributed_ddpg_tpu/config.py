@@ -205,6 +205,43 @@ class DDPGConfig:
     # parity, like the learner itself).
     serve_backend: str = "numpy"
 
+    # --- network serving front (serve/front/; docs/SERVING.md §front) ---
+    # External ingress over the same Batcher the served actors use:
+    # a length-prefixed-frame TCP server plus an HTTP/JSON adapter,
+    # versioned policy snapshots with canary promote, and per-tenant QoS.
+    # 0 = disabled (the default: serving stays in-process/mp-queue only);
+    # any other value binds that port on localhost (0 is also what tests
+    # pass programmatically to FrontServer for an ephemeral port — the
+    # config knob reserves 0 for "off" and FrontServer itself treats 0 as
+    # "pick one", matching the obs/ exporter convention).
+    front_port: int = 0
+    front_http_port: int = 0
+    # Server-side deadline: a request older than this when its batch
+    # completes is answered with a typed `timeout` wire error.
+    front_timeout_s: float = 2.0
+    # Canary split: fraction of traffic deterministically routed to the
+    # candidate version while one is staged (crc32(tenant:request_id)
+    # bucketing — replayable, not random).
+    front_canary_fraction: float = 0.1
+    # The live gate needs this many latency samples on BOTH stable and
+    # candidate before it can promote (ci_gate's arm-on-first-capture
+    # discipline applied to live traffic: never promote on thin data).
+    front_canary_min_requests: int = 50
+    # Allowed relative p95-latency regression of candidate vs stable;
+    # past it the canary auto-rolls-back (THRESHOLD's live twin).
+    front_canary_threshold: float = 0.5
+    # Tenant table: "name:priority[:rate[:burst]];..." — priority 0 is
+    # highest (never depth-shed), rate is tokens/s (0 = uncapped),
+    # burst defaults to max(1, rate). Unknown tenants get
+    # front_default_priority and no rate cap.
+    front_tenants: str = ""
+    front_default_priority: int = 1
+    # Queue-depth fraction where priority shedding begins: the LOWEST
+    # priority class sheds at this depth, higher classes at staggered
+    # deeper thresholds, priority 0 only at a full queue (typed
+    # overload) — the "sheds lowest-priority first" contract.
+    front_shed_start: float = 0.5
+
     # --- device-actor backend (actors/device_pool.py; docs/DEVICE_ACTORS.md) ---
     # Where rollouts run on the jax_tpu path. "host" (default): N worker
     # PROCESSES step CPU envs, OU noise runs in numpy, and rows cross
@@ -853,14 +890,54 @@ class DDPGConfig:
                     "composition and dispatch timing are wall-clock-driven, "
                     "which breaks the bit-identical-two-runs contract"
                 )
-            if self.sac:
-                raise ValueError(
-                    "serve_actors serves the deterministic head mu(s); SAC "
-                    "workers explore by SAMPLING their tanh-Gaussian policy "
-                    "with a local RNG, which a shared server cannot "
-                    "replicate per client — run SAC on the per-worker "
-                    "act() path"
-                )
+            # SAC is served too (PR 20): the server holds per-client
+            # sampling keys derived from (seed, tenant, request_id) and
+            # returns already-sampled actions (serve/server.py `sample`;
+            # docs/SERVING.md 'SAC serve head') — the old rejection of
+            # sac + serve_actors is lifted.
+        if self.front_port < 0 or self.front_port > 65535:
+            raise ValueError("front_port must be in [0, 65535] (0 = off)")
+        if self.front_http_port < 0 or self.front_http_port > 65535:
+            raise ValueError(
+                "front_http_port must be in [0, 65535] (0 = off)"
+            )
+        if (
+            self.front_port
+            and self.front_http_port
+            and self.front_port == self.front_http_port
+        ):
+            raise ValueError(
+                "front_port and front_http_port must differ: the frame "
+                "server and the HTTP adapter each bind their own socket"
+            )
+        if self.front_timeout_s <= 0:
+            raise ValueError("front_timeout_s must be > 0")
+        if not 0.0 < self.front_canary_fraction < 1.0:
+            raise ValueError(
+                "front_canary_fraction must be in (0, 1): 0 would starve "
+                "the candidate of gate samples forever, 1 would route ALL "
+                "traffic through an unproven version"
+            )
+        if self.front_canary_min_requests < 1:
+            raise ValueError("front_canary_min_requests must be >= 1")
+        if self.front_canary_threshold <= 0:
+            raise ValueError("front_canary_threshold must be > 0")
+        if self.front_default_priority < 0:
+            raise ValueError("front_default_priority must be >= 0")
+        if not 0.0 < self.front_shed_start <= 1.0:
+            raise ValueError("front_shed_start must be in (0, 1]")
+        if self.front_tenants:
+            # Fail fast at parse, not at first shed: a typo'd tenant
+            # table discovered mid-run would silently misprioritize.
+            from distributed_ddpg_tpu.serve.front.qos import parse_tenants
+
+            parse_tenants(self.front_tenants)
+        if (self.front_port or self.front_http_port) and not self.serve_actors:
+            raise ValueError(
+                "the network front rides the serve subsystem's "
+                "InferenceServer: set serve_actors=True (docs/SERVING.md "
+                "'Network front')"
+            )
         if self.actor_backend not in ("host", "device"):
             raise ValueError(
                 f"actor_backend must be 'host' or 'device', got "

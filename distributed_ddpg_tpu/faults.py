@@ -19,9 +19,9 @@ Grammar (';'-separated specs):
     spec      := component [':' target] ':' kind '@' at ['~' seconds]
                | 'pod' ':' proc ':' 'exit' '@' at ':' code
     component := worker | pool | shipper | prefetch | ckpt | transfer | pod
-                 | numeric | serve | devactor | slice
+                 | numeric | serve | devactor | slice | front
     kind      := crash | crashloop | hang | stall | slow | ioerror | kill
-                 | nan | inf | spike | corrupt | exit
+                 | nan | inf | spike | corrupt | exit | regress
 
 `at` is 1-based: for `worker` it is the env step inside that worker's
 FIRST incarnation (a respawned worker gets a clean slate — except
@@ -115,6 +115,23 @@ Fault semantics by component:
                              peer-loss-during-checkpoint; the step's slice
                              set stays incomplete and restore must fall
                              back to an older complete step (or exit 76)
+    front:accept:stall@K~S   the K-th accepted TCP connection's handler
+                             sleeps S before reading frames
+                             (serve/front/ingress.py) — that client sees
+                             wire latency; the acceptor and every other
+                             connection keep serving
+    front:frame:corrupt@K    the K-th decoded request frame is treated as
+                             corrupt: a typed bad_frame error goes back
+                             on the wire and the CONNECTION SURVIVES —
+                             the typed-error-never-kills-the-acceptor
+                             contract (docs/SERVING.md failure contract)
+    front:canary:regress@K~S every candidate-routed request from the K-th
+                             onward serves S seconds slower — SUSTAINED,
+                             not one-shot (FaultPlan.front_canary_
+                             regressions), because the canary gate trips
+                             on a p95 over min_requests samples, not an
+                             outlier; the gate must auto-roll-back and
+                             never promote the regressed version
 
 Numeric `at` ordinals count GUARDED learner steps on a monotonic clock
 (guardrails.GuardState.total) that is deliberately NOT rolled back by the
@@ -144,9 +161,9 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 COMPONENTS = ("worker", "pool", "shipper", "prefetch", "ckpt", "transfer",
-              "pod", "numeric", "serve", "devactor", "slice")
+              "pod", "numeric", "serve", "devactor", "slice", "front")
 KINDS = ("crash", "crashloop", "hang", "stall", "slow", "ioerror", "kill",
-         "nan", "inf", "spike", "corrupt", "exit")
+         "nan", "inf", "spike", "corrupt", "exit", "regress")
 
 # Worker `slow` faults throttle this many consecutive env steps, then lift
 # — bounded so a chaos soak keeps making progress past the fault.
@@ -171,6 +188,18 @@ _NUMERIC_PAIRS = {"grad": "nan", "replay": "inf", "loss": "spike"}
 _SERVE_KINDS = {
     "batcher": ("stall", "hang", "slow"),
     "dispatch": ("crash", "slow"),
+}
+# Front faults target the network serving front (serve/front/;
+# docs/SERVING.md "Network front"): `accept` stalls the K-th accepted
+# connection's handler (clients see wire latency, the acceptor survives),
+# `frame` corrupts the K-th decoded request frame (typed bad-frame error
+# on the wire, connection stays up), `canary` injects a SUSTAINED latency
+# regression into every candidate-routed request from ordinal K on — the
+# chaos vector the canary gate must catch and auto-roll-back.
+_FRONT_KINDS = {
+    "accept": ("stall", "slow", "hang"),
+    "frame": ("corrupt",),
+    "canary": ("regress",),
 }
 
 
@@ -213,6 +242,11 @@ def _default_duration(kind: str, rng: random.Random,
     host-site timeout."""
     if kind == "slow":
         return round(rng.uniform(0.05, 0.25), 3)
+    if kind == "regress":
+        # Canary latency regressions are per-request slowdowns applied to
+        # EVERY candidate request past the trigger: big enough to clear
+        # any live canary threshold, small enough to keep drills fast.
+        return round(rng.uniform(0.02, 0.1), 3)
     if kind in ("hang", "stall"):
         if component == "pod":
             return 3600.0
@@ -298,6 +332,18 @@ class FaultPlan:
             if s.component == "numeric" and s.target in ("grad", "loss"):
                 out.setdefault(s.target, []).append(s.at)
         return {k: tuple(sorted(v)) for k, v in out.items()}
+
+    def front_canary_regressions(self) -> Tuple[Tuple[int, float], ...]:
+        """(at, seconds) pairs for `front:canary:regress@K~S` specs:
+        unlike a FaultSite one-shot, a canary regression is SUSTAINED —
+        the front sleeps S on every candidate-routed request from its
+        K-th onward (serve/front/ingress.py), because the canary gate
+        needs a population of slow samples, not one outlier, before its
+        p95 delta can trip (docs/SERVING.md 'Network front')."""
+        return tuple(sorted(
+            (s.at, s.duration_s) for s in self.specs
+            if s.component == "front" and s.kind == "regress"
+        ))
 
     def numeric_replay_rows(self) -> Tuple[int, ...]:
         """Ingested-row ordinals (1-based, per process) whose reward is
@@ -421,6 +467,16 @@ def _parse_one(raw: str, rng: random.Random) -> FaultSpec:
         if kind not in _SERVE_KINDS[target]:
             raise bad(
                 f"serve:{target} takes kind in {_SERVE_KINDS[target]} "
+                f"(got {kind!r})"
+            )
+    elif component == "front":
+        if target not in _FRONT_KINDS:
+            raise bad(
+                f"front target must be one of {tuple(_FRONT_KINDS)}"
+            )
+        if kind not in _FRONT_KINDS[target]:
+            raise bad(
+                f"front:{target} takes kind in {_FRONT_KINDS[target]} "
                 f"(got {kind!r})"
             )
     else:

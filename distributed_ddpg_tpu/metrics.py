@@ -1120,6 +1120,199 @@ class ServeStats:
         return out
 
 
+class FrontStats:
+    """Thread-safe counters for the network serving front (serve/front/;
+    docs/SERVING.md 'Network front') — the `front_*` family every
+    train/final JSONL record carries when the front is armed, and the
+    digest tools.serve_bench --transport socket emits.
+
+    COUNTERS are cumulative (the run's ingress history — a shed or
+    rollback anywhere in the run matters even if the last interval was
+    quiet). The wire-latency TAIL is interval-scoped and resets at
+    snapshot, the same PhaseTimers reservoir discipline ServeStats uses:
+
+      front_requests        frames accepted over TCP (cumulative)
+      front_http_requests   requests accepted over the HTTP adapter
+                            (cumulative; NOT a subset of front_requests)
+      front_bad_frames      undecodable/oversized frames answered with a
+                            typed bad_frame error (cumulative)
+      front_sheds           requests rejected by per-tenant QoS before
+                            reaching the batcher (cumulative; TenantStats
+                            splits this by cause and tenant)
+      front_overloads       requests the batcher's bounded queue rejected
+                            past QoS admission — typed overload on the
+                            wire (cumulative)
+      front_timeouts        requests that missed front_timeout_s waiting
+                            for their batch — typed timeout (cumulative)
+      front_errors          dispatch failures surfaced as typed wire
+                            errors (cumulative)
+      front_canary_requests requests routed to the candidate version by
+                            the deterministic canary split (cumulative)
+      front_promotes        candidate versions atomically promoted to
+                            stable by the live gate (cumulative)
+      front_rollbacks       candidates rolled back by the gate — latency
+                            or error-rate regression vs stable
+                            (cumulative)
+      front_wire_p50_ms/front_wire_p95_ms/front_wire_max_ms
+                            interval wire latency tails, frame decoded ->
+                            response queued (the ci_gate
+                            -front_wire_p95_ms key pins the p95)
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self.requests = 0
+        self.http_requests = 0
+        self.bad_frames = 0
+        self.sheds = 0
+        self.overloads = 0
+        self.timeouts = 0
+        self.errors = 0
+        self.canary_requests = 0
+        self.promotes = 0
+        self.rollbacks = 0
+        self._reset_reservoirs()
+
+    def _reset_reservoirs(self) -> None:
+        self._wire = _Reservoir(
+            PhaseTimers.RESERVOIR_K,
+            (zlib.crc32(b"front_wire") ^ self._seed) & 0x7FFFFFFF,
+        )
+
+    def record_request(self, http: bool = False) -> None:
+        with self._lock:
+            if http:
+                self.http_requests += 1
+            else:
+                self.requests += 1
+
+    def record_bad_frame(self) -> None:
+        with self._lock:
+            self.bad_frames += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def record_overload(self) -> None:
+        with self._lock:
+            self.overloads += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_canary_request(self) -> None:
+        with self._lock:
+            self.canary_requests += 1
+
+    def record_promote(self) -> None:
+        with self._lock:
+            self.promotes += 1
+
+    def record_rollback(self) -> None:
+        with self._lock:
+            self.rollbacks += 1
+
+    def record_wire_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._wire.add(float(seconds))
+
+    def snapshot(self, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            out = {
+                "front_requests": self.requests,
+                "front_http_requests": self.http_requests,
+                "front_bad_frames": self.bad_frames,
+                "front_sheds": self.sheds,
+                "front_overloads": self.overloads,
+                "front_timeouts": self.timeouts,
+                "front_errors": self.errors,
+                "front_canary_requests": self.canary_requests,
+                "front_promotes": self.promotes,
+                "front_rollbacks": self.rollbacks,
+                "front_wire_p50_ms": round(
+                    1000.0 * self._wire.percentile(0.50), 3
+                ),
+                "front_wire_p95_ms": round(
+                    1000.0 * self._wire.percentile(0.95), 3
+                ),
+                "front_wire_max_ms": round(1000.0 * self._wire.max, 3),
+            }
+            if reset:
+                self._reset_reservoirs()
+        return out
+
+
+class TenantStats:
+    """Thread-safe per-tenant QoS counters (serve/front/qos.py;
+    docs/SERVING.md 'Network front') — the `tenant_*` family. All
+    cumulative: shed ordering is a run-level contract ("overload sheds
+    strictly lowest-priority first"), and the per-tenant split in
+    `per_tenant()` is the evidence the shed-ordering test asserts on.
+
+      tenant_count          distinct tenants seen this run
+      tenant_served         requests admitted past QoS, all tenants
+      tenant_shed_rate      requests shed by a tenant's token bucket
+                            (per-tenant rate cap, not overload)
+      tenant_shed_priority  requests shed by priority-ordered overload
+                            protection (queue depth past the tenant
+                            class's threshold)
+      tenant_shed_total     tenant_shed_rate + tenant_shed_priority
+      tenant_errors         typed errors returned to tenants after
+                            admission (dispatch/timeout/overload)
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Dict[str, int]] = {}
+
+    def _row(self, tenant: str) -> Dict[str, int]:
+        row = self._tenants.get(tenant)
+        if row is None:
+            row = {"served": 0, "shed_rate": 0, "shed_priority": 0,
+                   "errors": 0}
+            self._tenants[tenant] = row
+        return row
+
+    def record_served(self, tenant: str) -> None:
+        with self._lock:
+            self._row(tenant)["served"] += 1
+
+    def record_shed(self, tenant: str, cause: str) -> None:
+        """cause: 'rate' (token bucket) or 'priority' (overload shed)."""
+        with self._lock:
+            key = "shed_rate" if cause == "rate" else "shed_priority"
+            self._row(tenant)[key] += 1
+
+    def record_error(self, tenant: str) -> None:
+        with self._lock:
+            self._row(tenant)["errors"] += 1
+
+    def per_tenant(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {t: dict(row) for t, row in self._tenants.items()}
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            rows = list(self._tenants.values())
+            shed_rate = sum(r["shed_rate"] for r in rows)
+            shed_priority = sum(r["shed_priority"] for r in rows)
+            return {
+                "tenant_count": len(rows),
+                "tenant_served": sum(r["served"] for r in rows),
+                "tenant_shed_rate": shed_rate,
+                "tenant_shed_priority": shed_priority,
+                "tenant_shed_total": shed_rate + shed_priority,
+                "tenant_errors": sum(r["errors"] for r in rows),
+            }
+
+
 class Timer:
     """Running steps/sec meter for the actor/learner rate metrics.
     Monotonic clock: a wall-clock jump (NTP step, manual date set) on a

@@ -16,6 +16,9 @@ attach in-process (`ServeClient`; tools.serve_bench) or across processes
     observability (metrics.ServeStats).
   - client.ServeClient / client.ServeFront: the blocking local handle and
     the served-actor mp-queue front.
+  - front/: the production network front (docs/SERVING.md 'Network
+    front') — framed-TCP + HTTP ingress, versioned snapshots with canary
+    promote, per-tenant QoS.
 """
 
 from distributed_ddpg_tpu.serve.batcher import (
@@ -26,10 +29,19 @@ from distributed_ddpg_tpu.serve.batcher import (
     ServeTimeout,
 )
 from distributed_ddpg_tpu.serve.client import ServeClient, ServeFront
+from distributed_ddpg_tpu.serve.front import (
+    FrontClient,
+    FrontError,
+    FrontServer,
+    SnapshotStore,
+)
 from distributed_ddpg_tpu.serve.server import InferenceServer
 
 __all__ = [
     "Batcher",
+    "FrontClient",
+    "FrontError",
+    "FrontServer",
     "InferenceServer",
     "ServeClient",
     "ServeClosed",
@@ -37,4 +49,5 @@ __all__ = [
     "ServeFront",
     "ServeOverload",
     "ServeTimeout",
+    "SnapshotStore",
 ]

@@ -36,9 +36,12 @@ from distributed_ddpg_tpu.serve.batcher import (
 class ServeClient:
     """Blocking in-process handle over one InferenceServer."""
 
-    def __init__(self, server, timeout_s: float = 1.0):
+    def __init__(self, server, timeout_s: float = 1.0,
+                 tenant: str = "local"):
         self._server = server
         self.timeout_s = float(timeout_s)
+        self.tenant = tenant
+        self._rid = 0
 
     def act(self, obs, timeout_s: Optional[float] = None) -> np.ndarray:
         """One observation row in, one action row out. Raises typed on
@@ -58,6 +61,14 @@ class ServeClient:
         result = box[0]
         if isinstance(result, BaseException):
             raise result
+        if getattr(self._server, "sac", False):
+            # SAC serve head: the batch apply returns [mean | log_std];
+            # sample server-side with this client's per-request key
+            # (serve/server.py `sample`).
+            self._rid += 1
+            return self._server.sample(
+                result, tenant=self.tenant, request_id=self._rid
+            )
         return result
 
 
@@ -104,10 +115,19 @@ class ServeFront:
                 return  # transport torn down under us: pool is stopping
 
             def _cb(result, wid=wid, rid=rid):
-                self._respond(
-                    wid, rid,
-                    None if isinstance(result, BaseException) else result,
-                )
+                if isinstance(result, BaseException):
+                    self._respond(wid, rid, None)
+                    return
+                if getattr(self._server, "sac", False):
+                    # SAC serve head: sample with this worker's key
+                    # (tenant = worker id, request_id = its own rid
+                    # counter) so every worker gets its own replayable
+                    # exploration stream — the per-client RNG that used
+                    # to forbid sac + serve_actors now lives here.
+                    result = self._server.sample(
+                        result, tenant=str(wid), request_id=rid
+                    )
+                self._respond(wid, rid, result)
 
             try:
                 self._server.batcher.submit(np.asarray(obs, np.float32), _cb)

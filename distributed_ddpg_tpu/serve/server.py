@@ -37,6 +37,7 @@ and training share the host<->device bus under one accounting.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from typing import Optional, Tuple
 
@@ -69,6 +70,9 @@ class InferenceServer:
         fault_batcher=None,
         fault_dispatch=None,
         mesh=None,
+        sac: bool = False,
+        log_std_min: float = -5.0,
+        log_std_max: float = 2.0,
     ):
         if backend not in ("numpy", "jax"):
             raise ValueError(f"serve backend must be 'numpy' or 'jax', got {backend!r}")
@@ -88,10 +92,25 @@ class InferenceServer:
         self._mesh = mesh
         self.layout = layout
         self.obs_dim = int(layout[0][0][0])  # first layer w is (obs, hidden)
-        self.act_dim = int(layout[-1][0][1])
-        # Deterministic head only: mu(s) (serving SAC's sampling head would
-        # move each client's exploration RNG server-side; config.py forbids
-        # serve_actors with sac).
+        self.head_dim = int(layout[-1][0][1])
+        # SAC head: the final layer is [mean | log_std] (2*act_dim wide,
+        # actors/policy.actor_head_dim). The server ships HEAD rows
+        # ([mean | soft-clamped log_std]) out of the batch apply and
+        # squashes/samples per request with `sample()` — each client's
+        # exploration stream keyed by (seed, tenant, request_id), so the
+        # sampling RNG lives server-side without any cross-client
+        # coupling (docs/SERVING.md 'SAC serve head').
+        self.sac = bool(sac)
+        if self.sac and self.head_dim % 2:
+            raise ValueError(
+                "SAC head layout must be [mean | log_std] (even width); "
+                f"got final-layer width {self.head_dim} — build the "
+                "layout with actor_head_dim(act_dim, sac=True)"
+            )
+        self.act_dim = self.head_dim // 2 if self.sac else self.head_dim
+        self.log_std_min = float(log_std_min)
+        self.log_std_max = float(log_std_max)
+        self._sample_seed = int(seed)
         self._policy = NumpyPolicy(layout, action_scale, action_offset)
         self._param_lock = threading.Lock()
         self._param_source = param_source
@@ -178,7 +197,8 @@ class InferenceServer:
         obs h2d + apply + action d2h accounted like any other bus user),
         inline otherwise."""
         self._maybe_refresh()
-        nbytes = obs.nbytes + obs.shape[0] * self.act_dim * 4
+        out_dim = self.head_dim if self.sac else self.act_dim
+        nbytes = obs.nbytes + obs.shape[0] * out_dim * 4
         if self.scheduler is not None:
             return self.scheduler.submit(
                 "serve",
@@ -194,7 +214,54 @@ class InferenceServer:
                 return self._compute_jax(obs)
             # Row-wise (1, obs_dim) evaluation — the bit-identity parity
             # contract with the per-worker act() path (module docstring).
+            if self.sac:
+                return np.concatenate(
+                    [self._head_row(row) for row in obs], axis=0
+                )
             return np.concatenate([self._policy(row) for row in obs], axis=0)
+
+    def _head_row(self, row: np.ndarray) -> np.ndarray:
+        """SAC batch output: [mean | log_std] with the SAME soft clamp as
+        the jax head (models/mlp.actor_gaussian_apply), so the two
+        backends agree on the distribution `sample()` draws from."""
+        raw = self._policy.head(row)
+        mean, log_std_raw = np.split(raw, 2, axis=-1)
+        log_std = self.log_std_min + 0.5 * (
+            self.log_std_max - self.log_std_min
+        ) * (np.tanh(log_std_raw) + 1.0)
+        return np.concatenate([mean, log_std], axis=-1).astype(
+            np.float32, copy=False
+        )
+
+    def sample(self, head, tenant: str, request_id: int,
+               explore: bool = True) -> np.ndarray:
+        """Turn one SAC head row [mean | log_std] into an action row.
+        The exploration key is derived from (seed, tenant, request_id) —
+        stable across processes and replayable, so the SAME request
+        always samples the SAME action (the parity contract
+        tests/test_serve_front.py pins) and no two clients ever share an
+        RNG stream. explore=False returns the deterministic tanh(mean)
+        squash (eval traffic)."""
+        if not self.sac:
+            # lint: ok(typed-error): caller bug (sampling a deterministic
+            # head), not a runtime failure any recovery path handles
+            raise RuntimeError("sample() is the SAC serve head's API")
+        head = np.asarray(head, np.float32).reshape(-1)
+        mean, log_std = head[: self.act_dim], head[self.act_dim:]
+        if explore:
+            digest = hashlib.sha256(
+                f"{self._sample_seed}:{tenant}:{request_id}".encode()
+            ).digest()
+            rng = np.random.default_rng(
+                int.from_bytes(digest[:8], "little")
+            )
+            eps = rng.standard_normal(mean.shape).astype(np.float32)
+            u = mean + np.exp(log_std) * eps
+        else:
+            u = mean
+        return (
+            np.tanh(u) * self._policy.scale + self._policy.offset
+        ).astype(np.float32)
 
     def _build_jax_apply(self) -> None:
         # THE learner's actor head (models/mlp.actor_apply), not a local
@@ -204,13 +271,28 @@ class InferenceServer:
 
         import jax
 
-        from distributed_ddpg_tpu.models.mlp import actor_apply
-
-        apply = functools.partial(
+        from distributed_ddpg_tpu.models.mlp import (
             actor_apply,
-            action_scale=self._policy.scale,
-            action_offset=self._policy.offset,
+            actor_gaussian_apply,
         )
+
+        if self.sac:
+            # Head rows out, same [mean | log_std] contract as the numpy
+            # path; sampling stays host-side in sample() (per-client
+            # keys are a host concern, not a device one).
+            import jax.numpy as jnp
+
+            def apply(params, obs):
+                mean, log_std = actor_gaussian_apply(
+                    params, obs, self.log_std_min, self.log_std_max
+                )
+                return jnp.concatenate([mean, log_std], axis=-1)
+        else:
+            apply = functools.partial(
+                actor_apply,
+                action_scale=self._policy.scale,
+                action_offset=self._policy.offset,
+            )
         if self._mesh is None:
             self._jax_apply = jax.jit(apply)
         else:

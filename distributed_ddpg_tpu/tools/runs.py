@@ -350,6 +350,26 @@ def summarize_run(path: str) -> Dict[str, Any]:
             }
     digest["serve"] = serve
 
+    # Network-front digest (serve/front/; docs/SERVING.md 'Network
+    # front'): counters are cumulative (last = total), the wire-latency
+    # tails are interval-scoped (steady + worst interval). tenant_*
+    # rides in the same section — the QoS view of the same traffic.
+    front = {}
+    front_keys = sorted(
+        {
+            k for r in train + final for k in r
+            if k.startswith("front_") or k.startswith("tenant_")
+        }
+    )
+    for key in front_keys:
+        vals = _col(train + final, key)
+        if vals:
+            front[key] = {
+                "steady": _tail_mean(vals), "max": max(vals),
+                "last": vals[-1],
+            }
+    digest["front"] = front
+
     # Device-actor digest (actors/device_pool.py; docs/DEVICE_ACTORS.md):
     # rows/s and the per-chunk dispatch tails are interval-scoped
     # (steady + worst interval); env_steps/episodes/restarts are
@@ -519,6 +539,15 @@ def render_summary(digest: Dict[str, Any]) -> str:
             [
                 [k, v["steady"], v["max"], v["last"]]
                 for k, v in digest["serve"].items()
+            ],
+        ))
+    if digest.get("front"):
+        out.append("\n-- network front (docs/SERVING.md 'Network front')")
+        out.append(render_table(
+            ["field", "steady", "max", "last"],
+            [
+                [k, v["steady"], v["max"], v["last"]]
+                for k, v in digest["front"].items()
             ],
         ))
     if digest.get("devactor"):
@@ -698,6 +727,22 @@ def compare_runs(path_a: str, path_b: str) -> Tuple[str, List[List[Any]]]:
                     "_ms" in key or "p95" in key or "overload" in key
                     or "error" in key or "fallback" in key or "depth" in key
                 )
+            ))
+    for key in sorted(set(a.get("front", {})) | set(b.get("front", {}))):
+        fa_ = a.get("front", {}).get(key, {})
+        fb_ = b.get("front", {}).get(key, {})
+        # front_* / tenant_*: request totals and tenant_served are
+        # throughput (higher-is-better); wire-latency tails, sheds,
+        # overloads, timeouts, bad frames, errors, and rollbacks are all
+        # lower-is-better costs. front_promotes is a lifecycle fact —
+        # neither direction is a regression — but a delta is still worth
+        # seeing, so it rides the default higher-is-better arm.
+        add(key, fa_.get("steady"), fb_.get("steady"),
+            lower_better=(
+                "_ms" in key or "p95" in key or "p50" in key
+                or "shed" in key or "overload" in key or "timeout" in key
+                or "bad_frame" in key or "error" in key
+                or "rollback" in key
             ))
     for key in sorted(
         set(a.get("devactor", {})) | set(b.get("devactor", {}))

@@ -1136,10 +1136,85 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
                 if config.serve_backend == "jax" and config.model_axis > 1
                 else None
             ),
+            # SAC serve head (docs/SERVING.md): the batch apply returns
+            # [mean | log_std] rows and each client's action is sampled
+            # server-side with a (seed, tenant, request_id) key —
+            # serve_actors + sac is a supported pairing since PR 20.
+            sac=config.sac,
+            log_std_min=config.sac_log_std_min,
+            log_std_max=config.sac_log_std_max,
         ).start()
         serve_front = ServeFront(
             serve_server, *pool.serve_channels()
         ).start()
+
+    # --- network serving front (serve/front/; docs/SERVING.md §front) ---
+    # front_port/front_http_port > 0: external framed-TCP + HTTP/JSON
+    # ingress with versioned snapshots (canary promote) and per-tenant
+    # QoS. Each active version runs its own InferenceServer engine fed by
+    # the same layout; the learner's live params publish as version
+    # "live-0" so the front serves from step one, and later snapshots
+    # publish/promote through front_server's API (tools, tests). A bind
+    # failure downgrades to a warning — ingress must never kill the run
+    # it fronts (the obs/ exporter discipline).
+    front_server = None
+    if config.serve_actors and (config.front_port or config.front_http_port):
+        from distributed_ddpg_tpu.actors.policy import flatten_params
+        from distributed_ddpg_tpu.serve.front import FrontServer
+
+        def _make_front_engine():
+            return InferenceServer(
+                pool.layout,
+                spec.action_scale,
+                spec.action_offset,
+                max_batch=config.serve_max_batch,
+                max_latency_s=config.serve_max_latency_ms / 1000.0,
+                max_queue=config.serve_queue,
+                backend=config.serve_backend,
+                seed=config.seed,
+                sac=config.sac,
+                log_std_min=config.sac_log_std_min,
+                log_std_max=config.sac_log_std_max,
+            )
+
+        try:
+            front_server = FrontServer(
+                _make_front_engine,
+                port=config.front_port,
+                http_port=config.front_http_port or None,
+                timeout_s=config.front_timeout_s,
+                canary_fraction=config.front_canary_fraction,
+                canary_min_requests=config.front_canary_min_requests,
+                canary_threshold=config.front_canary_threshold,
+                tenants=config.front_tenants,
+                default_priority=config.front_default_priority,
+                shed_start=config.front_shed_start,
+                seed=config.seed,
+                fault_accept=(
+                    fault_plan.site("front", "accept") if fault_plan else None
+                ),
+                fault_frame=(
+                    fault_plan.site("front", "frame") if fault_plan else None
+                ),
+                canary_regressions=(
+                    fault_plan.front_canary_regressions()
+                    if fault_plan
+                    else ()
+                ),
+            )
+            front_server.publish(
+                "live-0", flatten_params(learner.actor_params_to_host())
+            )
+            front_server.start()
+            print(
+                f"[front] serving ingress on tcp:{front_server.port} "
+                f"http:{front_server.http_port or '-'} (stable=live-0)",
+                file=sys.stderr, flush=True,
+            )
+        except OSError as e:
+            front_server = None
+            print(f"[front] ingress disabled (bind failed: {e})",
+                  file=sys.stderr, flush=True)
 
     pool.start(learner.actor_params_to_host())
     _beat()  # first params d2h survived (an observed wedge point)
@@ -1334,7 +1409,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
         depth, and the workers' local-act fallback count."""
         if serve_server is None:
             return {}
-        return {**serve_server.snapshot(), **pool.serve_counters()}
+        out = {**serve_server.snapshot(), **pool.serve_counters()}
+        if front_server is not None:
+            # front_* + tenant_* ride the same record (metrics.FrontStats
+            # / TenantStats; docs/SERVING.md 'Network front').
+            out.update(front_server.snapshot())
+        return out
 
     def devactor_fields() -> Dict[str, float]:
         """devactor_* observability (metrics.DevActorStats;
@@ -2499,6 +2579,12 @@ def _train_jax_impl(config: DDPGConfig, _beat, _grant=lambda extra_s: None) -> D
             pass  # a failing beat must not mask the primary error
         pool.stop()
         _beat()
+        if front_server is not None:
+            # Network ingress first: stop accepting external traffic
+            # before the in-process serving machinery flushes; in-flight
+            # requests complete (FrontServer.stop closes every version
+            # engine, each draining its batcher).
+            front_server.stop()
         if serve_front is not None:
             # After the workers: no new requests can arrive. The front
             # stops first (nothing new enters the batcher), then the

@@ -59,7 +59,14 @@
 #              per device than the baseline's is a rule-table
 #              regression) and the higher-is-better tp_steps_per_s rate,
 #              both of which SKIP against pre-TP baselines and arm once
-#              a BENCH_TP=1 bench becomes the baseline.
+#              a BENCH_TP=1 bench becomes the baseline;
+#              plus the lower-is-better front_wire_p95_ms network-front
+#              pin (docs/SERVING.md 'Network front'), which SKIPs
+#              against pre-front baselines and arms once a socket-
+#              transport serve bench becomes the baseline — the wire
+#              round-trip tail regressing past threshold means the
+#              ingress path (framing, QoS admit, version routing) got
+#              slower, not the policy math.
 #              Keys the BASELINE lacks are SKIPped, so old BENCH_r*.json
 #              baselines gate on value alone and the new pins arm
 #              automatically once a newer bench becomes the baseline; a
@@ -92,8 +99,15 @@
 #              breaker/backoff/prober units, and the scripted-children
 #              shrink->grow cycle on CPU before any bench JSON is read
 #              (SUPERVISE_FULL=1 adds the slow supervised 2-process
-#              kill -> auto-shrink -> auto-grow gloo drill). All flags
-#              compose: `ci_gate.sh --lint --programs --obs cand.json`.
+#              kill -> auto-shrink -> auto-grow gloo drill).
+#   --serve-front  run scripts/serve_front_smoke.sh (the network-front
+#              smoke, docs/SERVING.md 'Network front'): wire framing +
+#              typed errors, QoS shed ordering, canary promote/rollback,
+#              SAC serve-head parity, and a 1s closed-loop socket bench
+#              before any bench JSON is read (SKIPs on pre-front trees;
+#              FRONT_FULL=1 adds the slow end-to-end train drill). All
+#              flags compose: `ci_gate.sh --lint --programs --obs
+#              cand.json`.
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "$0")/.." && pwd)"
@@ -104,12 +118,13 @@ while :; do
         --elastic) "$repo_root/scripts/elastic_smoke.sh"; shift ;;
         --obs) "$repo_root/scripts/obs_smoke.sh"; shift ;;
         --supervise) "$repo_root/scripts/supervisor_smoke.sh"; shift ;;
+        --serve-front) "$repo_root/scripts/serve_front_smoke.sh"; shift ;;
         *) break ;;
     esac
 done
-candidate="${1:?usage: ci_gate.sh [--lint] [--programs] [--elastic] [--obs] [--supervise] <candidate.json> [baseline.json]}"
+candidate="${1:?usage: ci_gate.sh [--lint] [--programs] [--elastic] [--obs] [--supervise] [--serve-front] <candidate.json> [baseline.json]}"
 baseline="${2:-}"
-keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,superstep_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s}"
+keys="${KEYS:-value,-ingest_ship_ms,-transfer_ingest_p95,-transfer_prefetch_p95,-transfer_d2h_p95,-guardrail_rollbacks,-serve_p95_ms,-serve_queue_depth_p95,devactor_rows_per_s,-replay_ingest_bytes_per_row,fused_steps_per_s,superstep_steps_per_s,-tp_param_bytes_per_device,tp_steps_per_s,-front_wire_p95_ms}"
 
 # Pick (or validate) the baseline: it must resolve at least one gate key,
 # else the gate would be a silent no-op (every key SKIPped = GATE PASS).

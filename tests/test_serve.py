@@ -404,8 +404,9 @@ def test_config_validation():
             serve_actors=True, strict_sync=True,
             max_learn_ratio=1.0, max_ingest_ratio=1.0,
         )
-    with pytest.raises(ValueError):
-        DDPGConfig(serve_actors=True, sac=True)
+    # PR 20: sac + serve_actors is a supported pairing (the SAC serve
+    # head, docs/SERVING.md) — it must CONSTRUCT now.
+    DDPGConfig(serve_actors=True, sac=True)
     with pytest.raises(ValueError):
         DDPGConfig(serve_max_batch=0)
     with pytest.raises(ValueError):
